@@ -1,0 +1,9 @@
+"""crdt-enc-tpu: a TPU-native encrypted-CRDT persistence/replication framework.
+
+Capability surface of chpio/crdt-enc (see SURVEY.md), rebuilt JAX-first:
+immutable content-addressed op/state files on a passively synced filesystem,
+LUKS-style layered key management, and bulk merge/compaction running as
+batched tensor folds on TPU.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+from .version_bytes import (
+    VERSION_LEN,
+    DeserializeError,
+    VersionBytes,
+    VersionBytesBuf,
+    VersionError,
+)
+from . import codec, versions
+
+__all__ = [
+    "VERSION_LEN",
+    "DeserializeError",
+    "VersionBytes",
+    "VersionBytesBuf",
+    "VersionError",
+    "codec",
+    "versions",
+]

@@ -1,0 +1,39 @@
+"""Canonical msgpack codec.
+
+All persisted CRDT state must serialize deterministically (byte-identical
+across host-reference and TPU paths, and across fold orders), so every map is
+emitted with lexicographically sorted keys and every container type is
+normalized before packing.  msgpack's C extension does the heavy lifting.
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+
+def pack(obj) -> bytes:
+    """Deterministic msgpack: sorted map keys, bin type for bytes."""
+    return msgpack.packb(_canon(obj), use_bin_type=True)
+
+
+def unpack(data: bytes):
+    """Decode canonical msgpack.  Arrays come back as tuples (use_list=False)
+    so that composite map keys — e.g. (replica, counter) dots — stay hashable."""
+    return msgpack.unpackb(
+        bytes(data), raw=False, strict_map_key=False, use_list=False
+    )
+
+
+def _canon(obj, as_key: bool = False):
+    if isinstance(obj, dict):
+        # Sort by the packed key bytes so ordering is type-stable.
+        items = [(_canon(k, as_key=True), _canon(v)) for k, v in obj.items()]
+        items.sort(key=lambda kv: msgpack.packb(kv[0], use_bin_type=True))
+        return {k: v for k, v in items}
+    if isinstance(obj, (list, tuple)):
+        # Map keys must stay hashable; tuples pack identically to lists.
+        seq = [_canon(x, as_key=as_key) for x in obj]
+        return tuple(seq) if as_key else seq
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return bytes(obj)
+    return obj

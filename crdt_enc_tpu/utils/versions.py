@@ -1,0 +1,37 @@
+"""Format-version registry for crdt-enc-tpu.
+
+The reference's de-facto config system is compile-time version sets checked at
+every decode boundary (reference crdt-enc/src/lib.rs:26-31, phf sets;
+xchacha lib.rs:11-16).  We mirror that with module-level frozen constants.
+
+All UUIDs below are this framework's own identifiers (generated fresh — this
+is a new wire format, not byte-compatible with the reference's Rust UUIDs,
+which are private to that implementation).
+"""
+
+import uuid
+
+# Outer container-format version stamped on every stored file
+# (ops, states, remote metas).  Reference analogue: CURRENT_VERSION lib.rs:26.
+CONTAINER_VERSION_1 = uuid.UUID("8f1d0c7e-2f6a-4bd1-9a3e-5c9b1a6e0d01").bytes
+CURRENT_CONTAINER_VERSION = CONTAINER_VERSION_1
+SUPPORTED_CONTAINER_VERSIONS = frozenset({CONTAINER_VERSION_1})
+
+# Cipher-envelope version stamped by the XChaCha20-Poly1305 cryptor on its
+# EncBox payloads.  Reference analogue: DATA_VERSION xchacha lib.rs:11.
+XCHACHA_DATA_VERSION_1 = uuid.UUID("3a7c44f2-9e51-4f0b-8d2c-7b61e4a9c102").bytes
+# Key-material version stamped on generated keys.  Reference: KEY_VERSION.
+XCHACHA_KEY_VERSION_1 = uuid.UUID("b45e19d8-6c3f-4aa7-92e0-1f8d57c3ab03").bytes
+
+# Identity (test) cryptor versions.
+IDENTITY_DATA_VERSION_1 = uuid.UUID("5d2f8b1a-0e47-4c69-b3d5-9a64e72f1c04").bytes
+IDENTITY_KEY_VERSION_1 = uuid.UUID("e91a3c56-7d20-4b8f-a6e1-48c5d90b2f05").bytes
+
+# Key-cryptor remote-meta format (the Keys CRDT blob in the meta MVReg).
+KEYS_META_VERSION_1 = uuid.UUID("27c6e0f9-15ab-4d72-8c43-6e9f01d5ba06").bytes
+SUPPORTED_KEYS_META_VERSIONS = frozenset({KEYS_META_VERSION_1})
+
+# Application-data versions are *not* fixed here: like the reference's
+# OpenOptions.supported_data_versions (lib.rs:730-731) they are chosen by the
+# application that owns the CRDT state type.  A reasonable default for tests:
+DEFAULT_DATA_VERSION_1 = uuid.UUID("c3b80d17-42fe-4e95-b7a8-2d50c61e9f07").bytes

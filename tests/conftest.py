@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+Benchmarks (bench.py) run on the real TPU in a separate process; tests
+exercise sharding/collectives on virtual CPU devices so they run anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,147 @@
+"""Wire-substrate tests: VersionBytes raw/msgpack forms and the Buf contract.
+
+Mirrors the reference's only test file
+(/root/reference/crdt-enc/tests/version_box_buf.rs:9-140) and its
+ensure_versions doctests (version_bytes.rs:52-71, 151-170), then goes further
+with round-trip and canonical-codec properties.
+"""
+
+import uuid
+
+import msgpack
+import pytest
+
+from crdt_enc_tpu.utils import (
+    VERSION_LEN,
+    DeserializeError,
+    VersionBytes,
+    VersionBytesBuf,
+    VersionError,
+    codec,
+)
+
+V1 = uuid.UUID("00000000-0000-0000-0000-0000000000aa").bytes
+V2 = uuid.UUID("00000000-0000-0000-0000-0000000000bb").bytes
+
+
+def test_raw_roundtrip():
+    vb = VersionBytes(V1, b"hello world")
+    raw = vb.serialize()
+    assert raw == V1 + b"hello world"
+    assert VersionBytes.deserialize(raw) == vb
+
+
+def test_raw_too_short():
+    with pytest.raises(DeserializeError):
+        VersionBytes.deserialize(b"short")
+
+
+def test_raw_empty_content():
+    vb = VersionBytes.deserialize(V1)
+    assert vb.version == V1 and vb.content == b""
+
+
+def test_msgpack_form_roundtrip():
+    vb = VersionBytes(V2, b"\x00\x01payload")
+    packed = codec.pack(vb.to_obj())
+    obj = codec.unpack(packed)
+    assert VersionBytes.from_obj(obj) == vb
+    # 2-element array of bins, like the reference's serde tuple form.
+    assert msgpack.unpackb(packed) == [V2, b"\x00\x01payload"]
+
+
+def test_ensure_version():
+    vb = VersionBytes(V1, b"x")
+    assert vb.ensure_version(V1) is vb
+    with pytest.raises(VersionError):
+        vb.ensure_version(V2)
+
+
+def test_ensure_versions_accept_reject():
+    vb = VersionBytes(V1, b"x")
+    assert vb.ensure_versions({V1, V2}) is vb
+    with pytest.raises(VersionError):
+        vb.ensure_versions({V2})
+    with pytest.raises(VersionError):
+        vb.ensure_versions(set())
+
+
+# ---- Buf contract (reference version_box_buf.rs) -------------------------
+
+
+def test_buf_simple():
+    # reference `simple` (:9-33): sequential chunk/advance through both parts.
+    buf = VersionBytesBuf(V1, b"content!")
+    assert buf.remaining() == VERSION_LEN + 8
+    assert bytes(buf.chunk()) == V1
+    buf.advance(VERSION_LEN)
+    assert buf.remaining() == 8
+    assert bytes(buf.chunk()) == b"content!"
+    buf.advance(8)
+    assert buf.remaining() == 0
+
+
+def test_buf_unaligned_advance():
+    # reference `unaligned_advance` (:36-63): advances straddling the
+    # 16-byte version/content boundary.
+    buf = VersionBytesBuf(V1, b"abcdef")
+    buf.advance(10)
+    assert bytes(buf.chunk()) == V1[10:]
+    buf.advance(6)  # exactly at the boundary
+    assert bytes(buf.chunk()) == b"abcdef"
+    buf2 = VersionBytesBuf(V1, b"abcdef")
+    buf2.advance(18)  # 2 bytes past the boundary
+    assert bytes(buf2.chunk()) == b"cdef"
+    assert buf2.remaining() == 4
+
+
+def test_buf_out_of_bounds_advance():
+    # reference `out_of_bounds_advance` (:66-70): over-advance must raise.
+    buf = VersionBytesBuf(V1, b"abc")
+    with pytest.raises(IndexError):
+        buf.advance(VERSION_LEN + 4)
+
+
+def test_buf_vectored():
+    # reference `vectored` (:73-140): chunk enumeration for writev.
+    buf = VersionBytesBuf(V1, b"xyz")
+    chunks = buf.chunks_vectored()
+    assert [bytes(c) for c in chunks] == [V1, b"xyz"]
+    buf.advance(VERSION_LEN + 1)
+    assert [bytes(c) for c in buf.chunks_vectored()] == [b"yz"]
+    buf.advance(2)
+    assert buf.chunks_vectored() == []
+    # limit of 1 yields only the first chunk
+    buf2 = VersionBytesBuf(V1, b"xyz")
+    assert [bytes(c) for c in buf2.chunks_vectored(limit=1)] == [V1]
+
+
+def test_buf_read_all_equals_serialize():
+    vb = VersionBytes(V2, b"roundtrip")
+    assert vb.buf().read_all() == vb.serialize()
+
+
+def test_canonical_pack_sorts_map_keys():
+    a = codec.pack({b"b": 1, b"a": 2})
+    b = codec.pack({b"a": 2, b"b": 1})
+    assert a == b
+
+
+def test_from_obj_rejects_malformed():
+    with pytest.raises(DeserializeError):
+        VersionBytes.from_obj([16, 3])
+    with pytest.raises(DeserializeError):
+        VersionBytes.from_obj(["x", "y"])
+    with pytest.raises(DeserializeError):
+        VersionBytes.from_obj([b"short", b"content"])
+
+
+def test_buf_negative_advance():
+    buf = VersionBytesBuf(V1, b"abc")
+    with pytest.raises(IndexError):
+        buf.advance(-5)
+
+
+def test_canonical_pack_tuple_keys():
+    packed = codec.pack({(1, 2): 3, (1, 1): 4})
+    assert codec.unpack(packed) == {(1, 1): 4, (1, 2): 3}
