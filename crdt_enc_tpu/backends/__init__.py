@@ -1,0 +1,13 @@
+from .fs import FsStorage
+from .identity_crypto import IdentityCryptor
+from .memory import MemoryRemote, MemoryStorage, content_name
+from .plain_keys import PlainKeyCryptor
+
+__all__ = [
+    "FsStorage",
+    "IdentityCryptor",
+    "MemoryRemote",
+    "MemoryStorage",
+    "PlainKeyCryptor",
+    "content_name",
+]

@@ -1,0 +1,50 @@
+from .adapters import (
+    CrdtAdapter,
+    HostAccelerator,
+    empty_adapter,
+    gcounter_adapter,
+    lwwmap_adapter,
+    mvreg_adapter,
+    orset_adapter,
+    pncounter_adapter,
+)
+from .core import (
+    Core,
+    CoreError,
+    Info,
+    LocalMeta,
+    MissingKeyError,
+    OpenOptions,
+    OpOrderError,
+    RemoteMeta,
+    StateWrapper,
+)
+from .cryptor import Cryptor
+from .key_cryptor import DanglingLatestKey, Key, KeyCryptor, Keys
+from .storage import Storage
+
+__all__ = [
+    "Core",
+    "CoreError",
+    "CrdtAdapter",
+    "Cryptor",
+    "DanglingLatestKey",
+    "HostAccelerator",
+    "Info",
+    "Key",
+    "KeyCryptor",
+    "Keys",
+    "LocalMeta",
+    "MissingKeyError",
+    "OpenOptions",
+    "OpOrderError",
+    "RemoteMeta",
+    "StateWrapper",
+    "Storage",
+    "empty_adapter",
+    "gcounter_adapter",
+    "lwwmap_adapter",
+    "mvreg_adapter",
+    "orset_adapter",
+    "pncounter_adapter",
+]

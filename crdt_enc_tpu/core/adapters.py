@@ -1,0 +1,110 @@
+"""CRDT-type adapters: how the core (de)serializes a state type and its ops.
+
+The reference core is generic over ``S: CmRDT + CvRDT + Serialize`` with op
+encoding via serde (lib.rs:189-197); here an adapter bundles the same
+knowledge for dynamically chosen state types, plus the *accelerator* —
+the pluggable execution backend for the two hot paths (per-op fold and
+state merge).  ``HostAccelerator`` is the plain loop; the TPU accelerator
+(crdt_enc_tpu/parallel/accel.py) batches onto the device kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..models import (
+    EmptyCrdt,
+    GCounter,
+    LWWMap,
+    LWWOp,
+    MVReg,
+    MVRegOp,
+    ORSet,
+    PNCounter,
+    VClock,
+)
+from ..models.orset import op_from_obj as orset_op_from_obj
+from ..models.vclock import Dot
+
+
+class HostAccelerator:
+    """Reference execution: sequential host loops (the thing the TPU path
+    replaces — HOT LOOPS #1/#2, reference lib.rs:458-466, 533-539)."""
+
+    def fold_ops(self, state, ops: list):
+        for op in ops:
+            state.apply(op)
+        return state
+
+    def merge_states(self, state, others: list):
+        for other in others:
+            state.merge(other)
+        return state
+
+
+@dataclass
+class CrdtAdapter:
+    name: bytes
+    new: Callable[[], object]
+    state_to_obj: Callable = field(default=lambda s: s.to_obj())
+    state_from_obj: Callable = None  # type: ignore[assignment]
+    op_to_obj: Callable = field(default=lambda op: op.to_obj())
+    op_from_obj: Callable = field(default=lambda obj: obj)
+
+
+def gcounter_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"gcounter",
+        new=GCounter,
+        state_from_obj=GCounter.from_obj,
+        op_from_obj=Dot.from_obj,
+    )
+
+
+def pncounter_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"pncounter",
+        new=PNCounter,
+        state_from_obj=PNCounter.from_obj,
+        op_to_obj=lambda op: [op[0], op[1].to_obj()],
+        op_from_obj=lambda obj: (int(obj[0]), Dot.from_obj(obj[1])),
+    )
+
+
+def orset_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"orset",
+        new=ORSet,
+        state_from_obj=ORSet.from_obj,
+        op_from_obj=orset_op_from_obj,
+    )
+
+
+def lwwmap_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"lwwmap",
+        new=LWWMap,
+        state_from_obj=LWWMap.from_obj,
+        op_from_obj=LWWOp.from_obj,
+    )
+
+
+def mvreg_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"mvreg",
+        new=MVReg,
+        state_from_obj=MVReg.from_obj,
+        op_to_obj=lambda op: [op.clock.to_obj(), op.value],
+        op_from_obj=lambda obj: MVRegOp(VClock.from_obj(obj[0]), obj[1]),
+    )
+
+
+def empty_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"empty",
+        new=EmptyCrdt,
+        state_from_obj=EmptyCrdt.from_obj,
+        op_to_obj=lambda op: None,
+        op_from_obj=lambda obj: None,
+    )

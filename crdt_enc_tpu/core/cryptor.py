@@ -1,0 +1,31 @@
+"""Cryptor port: abstract AEAD over opaque byte blobs.
+
+Mirrors the reference Cryptor trait (crdt-enc/src/cryptor.rs:11-27): key
+generation plus encrypt/decrypt, where keys and ciphertexts are VersionBytes
+so cipher formats can rotate independently of everything else.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from ..utils import VersionBytes
+
+
+class Cryptor(ABC):
+    @abstractmethod
+    async def gen_key(self) -> VersionBytes:
+        """Fresh random key material, tagged with the cipher's key version."""
+
+    @abstractmethod
+    async def encrypt(self, key: VersionBytes, data: bytes) -> bytes:
+        """Seal ``data``; returns the raw-serialized cipher envelope (a
+        VersionBytes tagged with the cipher's data version)."""
+
+    @abstractmethod
+    async def decrypt(self, key: VersionBytes, data: bytes) -> bytes:
+        """Open a cipher envelope produced by ``encrypt``."""
+
+    async def init(self, core) -> None: ...
+
+    async def set_remote_meta(self, meta) -> None: ...

@@ -1,0 +1,38 @@
+from .base import Crdt, EmptyCrdt, canonical_bytes
+from .counters import GCounter, PNCounter, NEG, POS
+from .lwwmap import LWWMap, LWWOp
+from .mvreg import MVReg, MVRegOp, ReadCtx
+from .orset import AddOp, ORSet, RmOp
+from .vclock import Actor, Dot, VClock
+
+# Registry used by state decoders that need to resolve a CRDT type by name.
+REGISTRY = {
+    b"empty": EmptyCrdt,
+    b"gcounter": GCounter,
+    b"pncounter": PNCounter,
+    b"mvreg": MVReg,
+    b"orset": ORSet,
+    b"lwwmap": LWWMap,
+}
+
+__all__ = [
+    "Actor",
+    "AddOp",
+    "Crdt",
+    "Dot",
+    "EmptyCrdt",
+    "GCounter",
+    "LWWMap",
+    "LWWOp",
+    "MVReg",
+    "MVRegOp",
+    "NEG",
+    "ORSet",
+    "POS",
+    "PNCounter",
+    "ReadCtx",
+    "REGISTRY",
+    "RmOp",
+    "VClock",
+    "canonical_bytes",
+]

@@ -1,0 +1,58 @@
+"""CRDT protocol: op-based (CmRDT) apply + state-based (CvRDT) merge.
+
+This package is the host-reference CRDT engine, replacing the reference's
+external ``crdts`` crate dependency (SURVEY.md §2 row 14; usage at
+/root/reference/crdt-enc/src/lib.rs:14,460-466,533-539).  Semantics here are
+the framework's ground truth: the TPU kernels in ``crdt_enc_tpu.ops`` must
+produce byte-identical canonical state.
+
+Design rule for every state type: ``to_obj()`` emits only msgpack-able
+structures in a *canonical* form (sorted, normalized, no redundant entries),
+so ``canonical_bytes()`` is deterministic regardless of op arrival order —
+that's what makes "byte-identical TPU result" a meaningful test.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+from ..utils import codec
+
+
+@runtime_checkable
+class Crdt(Protocol):
+    def apply(self, op: Any) -> None:  # CmRDT
+        ...
+
+    def merge(self, other: "Crdt") -> None:  # CvRDT
+        ...
+
+    def to_obj(self) -> Any: ...
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Crdt": ...
+
+
+def canonical_bytes(state) -> bytes:
+    return codec.pack(state.to_obj())
+
+
+class EmptyCrdt:
+    """No-op state type (reference utils/mod.rs:12-35): useful when a Core is
+    opened purely for key/metadata management."""
+
+    def apply(self, op) -> None:
+        pass
+
+    def merge(self, other) -> None:
+        pass
+
+    def to_obj(self):
+        return None
+
+    @classmethod
+    def from_obj(cls, obj) -> "EmptyCrdt":
+        return cls()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, EmptyCrdt)

@@ -1,0 +1,102 @@
+"""Last-writer-wins map with per-key registers and delete tombstones.
+
+The ``(timestamp, actor)`` pair totally orders writes (actor bytes break
+timestamp ties deterministically); deletes are tombstoned writes so they win
+over concurrent older puts and survive merges.  The TPU analogue is a
+segment-argmax over packed (ts, actor-rank) keys (``crdt_enc_tpu.ops.lww``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils import codec
+from .vclock import Actor
+
+
+@dataclass(frozen=True)
+class LWWOp:
+    key: object
+    ts: int
+    actor: Actor
+    value: object  # ignored when tombstone
+    tombstone: bool = False
+
+    def to_obj(self):
+        return [self.key, self.ts, self.actor, self.value, self.tombstone]
+
+    @classmethod
+    def from_obj(cls, obj) -> "LWWOp":
+        key, ts, actor, value, tombstone = obj
+        return cls(key, int(ts), bytes(actor), value, bool(tombstone))
+
+
+def _wins(a_ts: int, a_actor: bytes, a_val, b_ts: int, b_actor: bytes, b_val) -> bool:
+    """True if write A beats write B.  Total order: ts, then actor bytes,
+    then canonical value bytes (so even pathological duplicate (ts, actor)
+    writes converge deterministically)."""
+    if a_ts != b_ts:
+        return a_ts > b_ts
+    if a_actor != b_actor:
+        return a_actor > b_actor
+    return codec.pack(a_val) > codec.pack(b_val)
+
+
+@dataclass
+class LWWMap:
+    # key -> [ts, actor, value, tombstone]
+    entries: dict = field(default_factory=dict)
+
+    def put(self, key, ts: int, actor: Actor, value) -> LWWOp:
+        return LWWOp(key, ts, actor, value)
+
+    def delete(self, key, ts: int, actor: Actor) -> LWWOp:
+        return LWWOp(key, ts, actor, None, tombstone=True)
+
+    def apply(self, op) -> None:
+        if isinstance(op, (list, tuple)):
+            op = LWWOp.from_obj(op)
+        cur = self.entries.get(op.key)
+        new = [op.ts, op.actor, None if op.tombstone else op.value, op.tombstone]
+        if cur is None or _wins(op.ts, op.actor, new[2], cur[0], cur[1], cur[2]):
+            self.entries[op.key] = new
+
+    def merge(self, other: "LWWMap") -> None:
+        for key, theirs in other.entries.items():
+            cur = self.entries.get(key)
+            if cur is None or _wins(theirs[0], theirs[1], theirs[2], cur[0], cur[1], cur[2]):
+                self.entries[key] = list(theirs)
+
+    def get(self, key):
+        e = self.entries.get(key)
+        if e is None or e[3]:
+            return None
+        return e[2]
+
+    def keys(self) -> list:
+        return sorted(
+            (k for k, e in self.entries.items() if not e[3]),
+            key=lambda k: codec.pack(k),
+        )
+
+    def to_obj(self):
+        return {
+            k: [ts, actor, value, bool(tomb)]
+            for k, (ts, actor, value, tomb) in self.entries.items()
+        }
+
+    @classmethod
+    def from_obj(cls, obj) -> "LWWMap":
+        m = cls()
+        if obj is None:
+            return m
+        m.entries = {
+            k: [int(ts), bytes(actor), value, bool(tomb)]
+            for k, (ts, actor, value, tomb) in obj.items()
+        }
+        return m
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, LWWMap):
+            return NotImplemented
+        return codec.pack(self.to_obj()) == codec.pack(other.to_obj())

@@ -1,0 +1,43 @@
+from .columnar import (
+    KIND_ADD,
+    KIND_RM,
+    CounterColumns,
+    LwwColumns,
+    OrsetColumns,
+    Vocab,
+    counter_ops_to_columns,
+    dense_to_vclock,
+    lww_ops_to_columns,
+    orset_ops_to_columns,
+    orset_planes_to_state,
+    orset_state_to_planes,
+    vclock_to_dense,
+)
+from .counters import gcounter_fold, pncounter_fold, vclock_merge
+from .lww import lww_fold
+from .mvreg import mvreg_dominance_keep
+from .orset import orset_fold, orset_merge, orset_merge_many
+
+__all__ = [
+    "KIND_ADD",
+    "KIND_RM",
+    "CounterColumns",
+    "LwwColumns",
+    "OrsetColumns",
+    "Vocab",
+    "counter_ops_to_columns",
+    "dense_to_vclock",
+    "gcounter_fold",
+    "lww_fold",
+    "lww_ops_to_columns",
+    "mvreg_dominance_keep",
+    "orset_fold",
+    "orset_merge",
+    "orset_merge_many",
+    "orset_ops_to_columns",
+    "orset_planes_to_state",
+    "orset_state_to_planes",
+    "pncounter_fold",
+    "vclock_merge",
+    "vclock_to_dense",
+]

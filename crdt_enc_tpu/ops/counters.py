@@ -1,0 +1,63 @@
+"""Counter fold kernels: segment-max over replica ids.
+
+G-Counter compaction is the minimal end-to-end TPU slice (SURVEY.md §7): a
+batch of increment dots collapses to per-replica maxima in one scatter-max.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models.counters import NEG, POS
+
+
+@partial(jax.jit, static_argnames=("num_replicas",))
+def gcounter_fold(
+    clock0: jax.Array,  # (R,) int32
+    actor: jax.Array,  # (N,) int32  (== num_replicas ⇒ padding row)
+    counter: jax.Array,  # (N,) int32
+    *,
+    num_replicas: int,
+):
+    """Fold increment dots into the per-replica clock; value = sum(clock)."""
+    R = num_replicas
+    pad = actor >= R
+    new = jax.ops.segment_max(
+        jnp.where(pad, 0, counter), jnp.minimum(actor, R - 1), num_segments=R
+    )
+    clock = jnp.maximum(clock0, jnp.maximum(new, 0))
+    return clock, jnp.sum(clock.astype(jnp.int64))
+
+
+@partial(jax.jit, static_argnames=("num_replicas",))
+def pncounter_fold(
+    p0: jax.Array,  # (R,) int32
+    n0: jax.Array,  # (R,) int32
+    sign: jax.Array,  # (N,) int8 — POS | NEG
+    actor: jax.Array,  # (N,) int32
+    counter: jax.Array,  # (N,) int32
+    *,
+    num_replicas: int,
+):
+    R = num_replicas
+    pad = actor >= R
+    actor_ix = jnp.minimum(actor, R - 1)
+    p_new = jax.ops.segment_max(
+        jnp.where(~pad & (sign == POS), counter, 0), actor_ix, num_segments=R
+    )
+    n_new = jax.ops.segment_max(
+        jnp.where(~pad & (sign == NEG), counter, 0), actor_ix, num_segments=R
+    )
+    p = jnp.maximum(p0, jnp.maximum(p_new, 0))
+    n = jnp.maximum(n0, jnp.maximum(n_new, 0))
+    value = jnp.sum(p.astype(jnp.int64)) - jnp.sum(n.astype(jnp.int64))
+    return p, n, value
+
+
+@jax.jit
+def vclock_merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Elementwise-max merge of dense vector clocks (same replica vocab)."""
+    return jnp.maximum(a, b)

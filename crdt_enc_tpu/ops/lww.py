@@ -1,0 +1,62 @@
+"""LWW-map fold: per-key lexicographic argmax over (ts, actor, value).
+
+The host tie-break order (timestamp, then actor bytes, then canonical value
+bytes — crdt_enc_tpu/models/lwwmap.py) is reproduced on device by *rank
+interning*: actors and values are pre-sorted host-side so integer comparison
+matches byte comparison.  Timestamps arrive split into hi/lo 31-bit halves
+(``ts_split``) so arbitrary 62-bit timestamps work without x64 mode on TPU.
+Four cascaded segment-max passes implement the lexicographic order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+TS_SPLIT_BITS = 31
+TS_SPLIT_MASK = (1 << TS_SPLIT_BITS) - 1
+
+
+def ts_split(ts):
+    """Split non-negative int timestamps (< 2^62) into (hi, lo) int32."""
+    import numpy as np
+
+    ts = np.asarray(ts, np.int64)
+    if (ts < 0).any() or (ts >= (1 << 62)).any():
+        raise ValueError("timestamps must be in [0, 2^62)")
+    return (ts >> TS_SPLIT_BITS).astype(np.int32), (ts & TS_SPLIT_MASK).astype(
+        np.int32
+    )
+
+
+@partial(jax.jit, static_argnames=("num_keys",))
+def lww_fold(
+    key: jax.Array,  # (N,) int32   (== num_keys ⇒ padding row)
+    ts_hi: jax.Array,  # (N,) int32
+    ts_lo: jax.Array,  # (N,) int32
+    actor: jax.Array,  # (N,) int32  rank-interned
+    value: jax.Array,  # (N,) int32  rank-interned (tombstone included)
+    *,
+    num_keys: int,
+):
+    """Per-key winner selection.  Returns ``(win_hi, win_lo, win_actor,
+    win_value, present)``; ``present[k]`` is False for keys with no rows
+    (possible when folding into an existing key vocabulary)."""
+    K = num_keys
+    pad = key >= K
+    key_ix = jnp.minimum(key, K - 1)
+
+    def cascade(elig, col):
+        masked = jnp.where(elig, col, -1)
+        m = jnp.maximum(jax.ops.segment_max(masked, key_ix, num_segments=K), -1)
+        return elig & (col == m[key_ix]), m
+
+    elig = ~pad
+    elig, m_hi = cascade(elig, ts_hi)
+    elig, m_lo = cascade(elig, ts_lo)
+    elig, m_actor = cascade(elig, actor)
+    elig, m_value = cascade(elig, value)
+    present = m_hi > -1
+    return m_hi, m_lo, m_actor, m_value, present

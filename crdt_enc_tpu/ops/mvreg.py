@@ -1,0 +1,30 @@
+"""MVReg dominance filter as a tensor program.
+
+Given V candidate values with dense clocks ``(V, R)``, keep each value whose
+clock is not strictly dominated by another candidate's clock — the CvRDT
+merge rule of crdt_enc_tpu/models/mvreg.py, O(V²R) pairwise but fully
+parallel.  V is small in practice (concurrent writers), so this exists for
+completeness and for the batched metadata-merge path, not throughput.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def mvreg_dominance_keep(clocks: jax.Array, valid: jax.Array) -> jax.Array:
+    """``clocks``: (V, R) int32; ``valid``: (V,) bool mask of real rows.
+    Returns (V,) bool — rows that survive the dominance filter.
+
+    Caller contract: rows are distinct (clock, value) pairs — dedup of
+    identical pairs happens host-side (models/mvreg.py _canonicalize), since
+    value identity is not visible to this kernel.  Identical clocks with
+    different values are concurrent and both survive.
+    """
+    ge = jnp.all(clocks[:, None, :] >= clocks[None, :, :], axis=-1)  # (V, V)
+    gt = jnp.any(clocks[:, None, :] > clocks[None, :, :], axis=-1)
+    dominates = ge & gt  # [j, i]: j strictly dominates i
+    dominated = jnp.any(dominates & valid[:, None], axis=0)
+    return valid & ~dominated

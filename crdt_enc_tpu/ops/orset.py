@@ -1,0 +1,133 @@
+"""ORSet fold and merge as jitted tensor programs — the north-star kernels.
+
+These replace the reference's per-op/per-state host loops (HOT LOOP #2
+``state.apply(op)`` at crdt-enc/src/lib.rs:533-539 and HOT LOOP #1
+``state.merge`` at lib.rs:458-466) with batched XLA reductions:
+
+* **fold**: a whole op batch (adds as dots, removes flattened to per-replica
+  horizon rows) collapses into the state planes via two ``segment_max``
+  scatters and elementwise masks.  Order-independence of the dense formulas
+  (max over monotone per-replica counters) is exactly why this is legal — the
+  property tests in tests/test_crdt_laws.py pin the host semantics and
+  tests/test_ops_kernels.py pins host≡TPU byte-equality.
+* **merge**: the Orswot clock-filter merge as pure elementwise arithmetic
+  over ``(E, R)`` planes.
+
+All shapes are static under jit; ragged op batches are padded with no-op rows
+(``actor = R`` sentinel column, masked out) so recompilation is bounded by
+shape buckets, not batch contents.  Counters are int32 and always ≥ 1 for
+real dots, so 0 is the universal "absent" value and empty ``segment_max``
+segments (dtype-min) clamp back to 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .columnar import KIND_ADD, KIND_RM
+
+
+@partial(jax.jit, static_argnames=("num_members", "num_replicas"))
+def orset_fold(
+    clock0: jax.Array,  # (R,) int32
+    add0: jax.Array,  # (E, R) int32
+    rm0: jax.Array,  # (E, R) int32
+    kind: jax.Array,  # (N,) int8
+    member: jax.Array,  # (N,) int32
+    actor: jax.Array,  # (N,) int32  (== num_replicas ⇒ padding row)
+    counter: jax.Array,  # (N,) int32
+    *,
+    num_members: int,
+    num_replicas: int,
+):
+    """Fold an op batch into normalized ORSet planes.
+
+    Returns ``(clock, add, rm)`` in canonical/normalized form: entries
+    zeroed where ``add ≤ rm``, horizons zeroed where ``rm ≤ clock``.
+    """
+    E, R = num_members, num_replicas
+    pad = actor >= R  # sentinel rows from bucket padding
+    is_add = (kind == KIND_ADD) & ~pad
+    is_rm = (kind == KIND_RM) & ~pad
+    actor_ix = jnp.minimum(actor, R - 1)
+
+    # Stale-add mask: a dot the initial state has already seen is a replay.
+    seen = counter <= clock0[actor_ix]
+    live_add = is_add & ~seen
+
+    seg = member * R + actor_ix
+    add_new = jax.ops.segment_max(
+        jnp.where(live_add, counter, 0), seg, num_segments=E * R
+    )
+    rm_new = jax.ops.segment_max(jnp.where(is_rm, counter, 0), seg, num_segments=E * R)
+    # clamp empty segments (dtype-min fill) back to "absent"
+    add_new = jnp.maximum(add_new, 0).reshape(E, R)
+    rm_new = jnp.maximum(rm_new, 0).reshape(E, R)
+
+    # Adds advance the global clock; removes never do.
+    clock_new = jax.ops.segment_max(
+        jnp.where(live_add, counter, 0), actor_ix, num_segments=R
+    )
+    clock = jnp.maximum(clock0, jnp.maximum(clock_new, 0))
+
+    add = jnp.maximum(add0, add_new)
+    rm = jnp.maximum(rm0, rm_new)
+
+    # Normalize: a horizon kills every dot it covers; a horizon the clock
+    # caught up with has fully applied.
+    add = jnp.where(add > rm, add, 0)
+    rm = jnp.where(rm > clock[None, :], rm, 0)
+    return clock, add, rm
+
+
+@jax.jit
+def orset_merge(
+    clock_a: jax.Array,
+    add_a: jax.Array,
+    rm_a: jax.Array,
+    clock_b: jax.Array,
+    add_b: jax.Array,
+    rm_b: jax.Array,
+):
+    """CvRDT merge of two dense ORSet states over the same (members,
+    replicas) vocabularies.  Pure elementwise — the tombstone-free
+    clock-filter rule (see crdt_enc_tpu/models/orset.py module docs)."""
+    clock = jnp.maximum(clock_a, clock_b)
+    same = add_a == add_b
+    surv_a = jnp.where(same | (add_a > clock_b[None, :]), add_a, 0)
+    surv_b = jnp.where(same | (add_b > clock_a[None, :]), add_b, 0)
+    add = jnp.maximum(surv_a, surv_b)
+    rm = jnp.maximum(rm_a, rm_b)
+    add = jnp.where(add > rm, add, 0)
+    rm = jnp.where(rm > clock[None, :], rm, 0)
+    return clock, add, rm
+
+
+@jax.jit
+def _merge_halves(c1, a1, r1, c2, a2, r2):
+    return jax.vmap(orset_merge)(c1, a1, r1, c2, a2, r2)
+
+
+def orset_merge_many(clocks: jax.Array, adds: jax.Array, rms: jax.Array):
+    """Merge a stacked batch of S states ``(S,R) / (S,E,R)`` into one.
+
+    A tree reduction: S partial states (from S devices or S snapshot files)
+    collapse in ⌈log2 S⌉ rounds of the pairwise merge.  Merge associativity
+    (tests/test_crdt_laws.py) is what makes the tree order legal.
+    """
+    c, a, r = jnp.asarray(clocks), jnp.asarray(adds), jnp.asarray(rms)
+    while c.shape[0] > 1:
+        s = c.shape[0]
+        half = s // 2
+        cm, am, rmm = _merge_halves(
+            c[:half], a[:half], r[:half], c[half : 2 * half], a[half : 2 * half], r[half : 2 * half]
+        )
+        if s % 2:
+            cm = jnp.concatenate([cm, c[-1:]])
+            am = jnp.concatenate([am, a[-1:]])
+            rmm = jnp.concatenate([rmm, r[-1:]])
+        c, a, r = cm, am, rmm
+    return c[0], a[0], r[0]

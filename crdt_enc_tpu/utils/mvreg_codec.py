@@ -1,0 +1,67 @@
+"""Codecs folding an ``MVReg[VersionBytes]`` into one CRDT value and back.
+
+The remote metadata gives each plugin one MVReg register holding opaque
+versioned blobs (reference lib.rs:745-750).  When a plugin's blob is itself
+a CRDT (e.g. the Keys CRDT), concurrent register values must be *decoded and
+merged*, not tie-broken: version-check each blob, optionally transform
+(decrypt), msgpack-decode, then CvRDT-merge all of them (reference
+utils/mod.rs:37-126).  Writing back encodes the merged value under the
+writer's add-context so it supersedes everything it saw (mod.rs:128-163).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Awaitable, Callable, Iterable
+
+from . import codec
+from .version_bytes import VersionBytes
+
+
+async def _maybe_await(x):
+    if inspect.isawaitable(x):
+        return await x
+    return x
+
+
+async def decode_version_bytes_mvreg(
+    mvreg,
+    supported_versions: Iterable[bytes],
+    crdt_cls,
+    transform: Callable[[VersionBytes], bytes | Awaitable[bytes]] | None = None,
+):
+    """Fold all concurrent register values into one ``crdt_cls`` instance.
+
+    ``transform`` maps the version-checked blob to cleartext msgpack (e.g.
+    decrypt); default takes the content as-is.  Returns None if the register
+    is empty.
+    """
+    values = mvreg.read().values
+    if not values:
+        return None
+    merged = None
+    for obj in values:
+        vb = VersionBytes.from_obj(obj).ensure_versions(supported_versions)
+        raw = await _maybe_await(transform(vb)) if transform else vb.content
+        value = crdt_cls.from_obj(codec.unpack(raw))
+        if merged is None:
+            merged = value
+        else:
+            merged.merge(value)
+    return merged
+
+
+async def encode_version_bytes_mvreg(
+    mvreg,
+    value,
+    actor: bytes,
+    version: bytes,
+    transform: Callable[[bytes], bytes | Awaitable[bytes]] | None = None,
+) -> None:
+    """Write ``value`` (a CRDT) into the register, superseding every value
+    the current read observes (derived add-context, mod.rs:128-163)."""
+    raw = codec.pack(value.to_obj())
+    if transform:
+        raw = await _maybe_await(transform(raw))
+    vb = VersionBytes(version, raw)
+    mvreg.apply(mvreg.write_ctx(actor, vb.to_obj()))

@@ -1,0 +1,166 @@
+"""Regressions for review findings: key-id envelope selection, durable
+producer cursor, and race-free immutable op publishes."""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from crdt_enc_tpu.backends import (
+    FsStorage,
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import Core, Cryptor, OpenOptions, gcounter_adapter
+from crdt_enc_tpu.utils import VersionBytes
+from crdt_enc_tpu.utils.versions import (
+    DEFAULT_DATA_VERSION_1,
+    IDENTITY_DATA_VERSION_1,
+    IDENTITY_KEY_VERSION_1,
+)
+
+
+class CheckedCryptor(IdentityCryptor):
+    """Identity transport that *verifies the key*: wrong key ⇒ hard error,
+    like a real AEAD tag failure."""
+
+    async def encrypt(self, key: VersionBytes, data: bytes) -> bytes:
+        key.ensure_version(IDENTITY_KEY_VERSION_1)
+        tag = hashlib.sha3_256(key.content + data).digest()[:8]
+        return VersionBytes(IDENTITY_DATA_VERSION_1, tag + data).serialize()
+
+    async def decrypt(self, key: VersionBytes, data: bytes) -> bytes:
+        key.ensure_version(IDENTITY_KEY_VERSION_1)
+        body = (
+            VersionBytes.deserialize(data)
+            .ensure_version(IDENTITY_DATA_VERSION_1)
+            .content
+        )
+        tag, payload = body[:8], body[8:]
+        if hashlib.sha3_256(key.content + payload).digest()[:8] != tag:
+            raise ValueError("wrong key (simulated AEAD tag mismatch)")
+        return payload
+
+
+def make_opts(storage, cryptor=None, create=True):
+    return OpenOptions(
+        storage=storage,
+        cryptor=cryptor or CheckedCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=gcounter_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=create,
+    )
+
+
+def test_concurrent_bootstrap_two_keys_both_decryptable():
+    """Two replicas bootstrap disjoint keys before their remotes sync (the
+    syncthing split-brain); after sync each must decrypt the other's files
+    via the key id recorded in the envelope."""
+
+    async def go():
+        ra, rb = MemoryRemote(), MemoryRemote()
+        ca = await Core.open(make_opts(MemoryStorage(ra)))
+        cb = await Core.open(make_opts(MemoryStorage(rb)))
+        await ca.update(lambda s: s.inc(ca.actor_id, 3))
+        await cb.update(lambda s: s.inc(cb.actor_id, 4))
+        # the sync tool merges the trees (union of immutable files)
+        ra.metas.update(rb.metas)
+        ra.states.update(rb.states)
+        for actor, log in rb.ops.items():
+            ra.ops.setdefault(actor, {}).update(log)
+        await ca.read_remote()
+        assert ca.with_state(lambda s: s.read()) == 7
+
+    asyncio.run(go())
+
+
+def test_unknown_key_is_loud_not_silent():
+    async def go():
+        ra, rb = MemoryRemote(), MemoryRemote()
+        ca = await Core.open(make_opts(MemoryStorage(ra)))
+        cb = await Core.open(make_opts(MemoryStorage(rb)))
+        await cb.update(lambda s: s.inc(cb.actor_id, 4))
+        # ops sync over but the key metadata does NOT (partial sync)
+        for actor, log in rb.ops.items():
+            ra.ops.setdefault(actor, {}).update(log)
+        from crdt_enc_tpu.core import MissingKeyError
+
+        with pytest.raises(MissingKeyError):
+            await ca.read_remote()
+
+    asyncio.run(go())
+
+
+def test_producer_cursor_survives_restart(tmp_path):
+    """Write, compact, 'restart' the process, write again WITHOUT
+    read_remote: the new op file must land past the compacted range so
+    consumers whose scan cursor is already beyond v1 still find it.
+    (Without the durable cursor it lands at v1 and is invisible to them
+    forever — the silent-loss scenario.)"""
+
+    async def go():
+        local, remote = str(tmp_path / "l1"), str(tmp_path / "r")
+        c1 = await Core.open(make_opts(FsStorage(local, remote)))
+        actor = c1.actor_id
+        await c1.update(lambda s: s.inc(actor, 3))
+        await c1.update(lambda s: s.inc(actor, 2))
+        await c1.compact()
+        # a consumer ingests the snapshot: its scan cursor is now v2
+        c2 = await Core.open(make_opts(FsStorage(str(tmp_path / "l2"), remote)))
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.read()) == 5
+        # restart the producer; write immediately (no read_remote)
+        c1b = await Core.open(make_opts(FsStorage(local, remote), create=False))
+        assert c1b.actor_id == actor
+        await c1b.update(lambda s: s.inc(actor, 10))
+        # the op file must be at v3 — past the compacted v1..v2 range
+        ops_dir = tmp_path / "r" / "ops" / actor.hex()
+        assert sorted(p.name for p in ops_dir.iterdir()) == ["3"]
+        # the consumer's next scan finds it (G-Counter dot folds as max:
+        # the restarted producer derived from an empty state, so its dot is
+        # an absolute 10 — convergence, not 5+10; apps wanting true
+        # increments read_remote first, the documented resume protocol)
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.read()) == 10
+
+    asyncio.run(go())
+
+
+def test_restart_with_resume_protocol_increments_correctly(tmp_path):
+    """The documented resume: open + read_remote, then write — increments
+    continue from the folded state."""
+
+    async def go():
+        local, remote = str(tmp_path / "l1"), str(tmp_path / "r")
+        c1 = await Core.open(make_opts(FsStorage(local, remote)))
+        await c1.update(lambda s: s.inc(c1.actor_id, 5))
+        await c1.compact()
+        c1b = await Core.open(make_opts(FsStorage(local, remote), create=False))
+        await c1b.read_remote()
+        await c1b.update(lambda s: s.inc(c1b.actor_id, 10))
+        c2 = await Core.open(make_opts(FsStorage(str(tmp_path / "l2"), remote)))
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.read()) == 15
+
+    asyncio.run(go())
+
+
+def test_store_ops_collision_is_detected(tmp_path):
+    async def go():
+        remote = str(tmp_path / "r")
+        s1 = FsStorage(str(tmp_path / "l1"), remote)
+        s2 = FsStorage(str(tmp_path / "l2"), remote)
+        actor = b"\x01" * 16
+        await s1.store_ops(actor, 1, b"first writer wins")
+        with pytest.raises(FileExistsError):
+            await s2.store_ops(actor, 1, b"second writer must fail")
+        # identical content is an idempotent replay, not an error
+        await s2.store_ops(actor, 1, b"first writer wins")
+        [(a, v, data)] = await s1.load_ops([(actor, 1)])
+        assert data == b"first writer wins"
+
+    asyncio.run(go())

@@ -1,0 +1,257 @@
+"""Property tests: CRDT laws under random op histories.
+
+Strategy: generate a causally consistent global op history (ops created
+against an oracle state, so remove-contexts observe real dots), then assert
+
+* convergence: any per-actor-order-preserving delivery reaches identical
+  canonical bytes,
+* merge laws: commutativity, associativity, idempotence of CvRDT merge,
+* CmRDT/CvRDT agreement: folding ops equals merging per-replica states.
+
+Per-actor ordering is the framework's delivery contract (op files are applied
+in version order per actor, cf. SURVEY.md §3.3); cross-actor interleaving is
+adversarial (chosen by hypothesis).
+"""
+
+import uuid
+
+from hypothesis import given, settings, strategies as st
+
+from crdt_enc_tpu.models import (
+    GCounter,
+    LWWMap,
+    MVReg,
+    ORSet,
+    PNCounter,
+    canonical_bytes,
+)
+
+ACTORS = [uuid.UUID(int=i + 1).bytes for i in range(4)]
+MEMBERS = [b"a", b"b", b"c"]
+
+
+def interleave(streams, rng: "st.DataObject"):
+    """Draw one per-stream-order-preserving interleaving."""
+    streams = [list(s) for s in streams if s]
+    out = []
+    while streams:
+        i = rng.draw(st.integers(0, len(streams) - 1))
+        out.append(streams[i].pop(0))
+        if not streams[i]:
+            streams.pop(i)
+    return out
+
+
+# ---- ORSet ---------------------------------------------------------------
+
+orset_script = st.lists(
+    st.tuples(
+        st.integers(0, len(ACTORS) - 1),
+        st.sampled_from(["add", "rm"]),
+        st.integers(0, len(MEMBERS) - 1),
+    ),
+    max_size=24,
+)
+
+
+def orset_history(script):
+    """Run the script against an oracle; return (oracle, per-actor op streams)."""
+    oracle = ORSet()
+    streams = {a: [] for a in ACTORS}
+    for actor_i, kind, member_i in script:
+        actor, member = ACTORS[actor_i], MEMBERS[member_i]
+        if kind == "add":
+            op = oracle.add_ctx(actor, member)
+        else:
+            op = oracle.rm_ctx(member)
+            if op.ctx.is_empty():
+                continue  # removing nothing is a no-op, not an op file
+        oracle.apply(op)
+        streams[actor].append(op)
+    return oracle, [s for s in streams.values() if s]
+
+
+@settings(max_examples=150, deadline=None)
+@given(orset_script, st.data())
+def test_orset_convergence_under_interleaving(script, data):
+    oracle, streams = orset_history(script)
+    replica = ORSet()
+    for op in interleave(streams, data):
+        replica.apply(op)
+    assert canonical_bytes(replica) == canonical_bytes(oracle)
+
+
+@settings(max_examples=150, deadline=None)
+@given(orset_script, orset_script, st.data())
+def test_orset_merge_laws(script_a, script_b, data):
+    # two divergent histories from a (possibly empty) shared prefix
+    _, streams_a = orset_history(script_a)
+    _, streams_b = orset_history(script_b)
+    sa, sb = ORSet(), ORSet()
+    for op in interleave(streams_a, data):
+        sa.apply(op)
+    for op in interleave(streams_b, data):
+        sb.apply(op)
+
+    ab = ORSet.from_obj(sa.to_obj())
+    ab.merge(sb)
+    ba = ORSet.from_obj(sb.to_obj())
+    ba.merge(sa)
+    assert canonical_bytes(ab) == canonical_bytes(ba)  # commutative
+
+    again = ORSet.from_obj(ab.to_obj())
+    again.merge(sb)
+    again.merge(sa)
+    assert canonical_bytes(again) == canonical_bytes(ab)  # idempotent
+
+    # associativity with a third state
+    sc = ORSet()
+    sc.apply(sc.add_ctx(ACTORS[0], MEMBERS[0]))
+    left = ORSet.from_obj(sa.to_obj())
+    left.merge(sb)
+    left.merge(sc)
+    right_inner = ORSet.from_obj(sb.to_obj())
+    right_inner.merge(sc)
+    right = ORSet.from_obj(sa.to_obj())
+    right.merge(right_inner)
+    assert canonical_bytes(left) == canonical_bytes(right)
+
+
+@settings(max_examples=100, deadline=None)
+@given(orset_script, st.data())
+def test_orset_fold_equals_merge(script, data):
+    oracle, streams = orset_history(script)
+    # each actor's ops applied on its own replica (per-actor causal order),
+    # then states merged in a random order
+    replicas = []
+    for stream in streams:
+        r = ORSet()
+        for op in stream:
+            r.apply(op)
+        replicas.append(r)
+    merged = ORSet()
+    order = interleave([[i] for i in range(len(replicas))], data)
+    for i in order:
+        merged.merge(replicas[i])
+    assert sorted(map(repr, merged.members())) == sorted(map(repr, oracle.members()))
+
+
+# ---- counters ------------------------------------------------------------
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(0, len(ACTORS) - 1),
+            st.sampled_from(["inc", "dec"]),
+            st.integers(1, 5),
+        ),
+        max_size=30,
+    ),
+    st.data(),
+)
+def test_pncounter_convergence(script, data):
+    oracle = PNCounter()
+    streams = {a: [] for a in ACTORS}
+    total = 0
+    for actor_i, kind, steps in script:
+        actor = ACTORS[actor_i]
+        op = oracle.inc(actor, steps) if kind == "inc" else oracle.dec(actor, steps)
+        total += steps if kind == "inc" else -steps
+        oracle.apply(op)
+        streams[actor].append(op)
+    replica = PNCounter()
+    for op in interleave(list(streams.values()), data):
+        replica.apply(op)
+    assert replica.read() == oracle.read() == total
+    assert canonical_bytes(replica) == canonical_bytes(oracle)
+    merged = PNCounter.from_obj(replica.to_obj())
+    merged.merge(oracle)
+    assert canonical_bytes(merged) == canonical_bytes(oracle)
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(st.tuples(st.integers(0, 3), st.integers(1, 4)), max_size=20))
+def test_gcounter_merge_commutes(script):
+    a, b = GCounter(), GCounter()
+    for actor_i, steps in script:
+        target = a if actor_i % 2 == 0 else b
+        target.apply(target.inc(ACTORS[actor_i], steps))
+    ab = GCounter.from_obj(a.to_obj())
+    ab.merge(b)
+    ba = GCounter.from_obj(b.to_obj())
+    ba.merge(a)
+    assert canonical_bytes(ab) == canonical_bytes(ba)
+    assert ab.read() == ba.read()
+
+
+# ---- MVReg ---------------------------------------------------------------
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.lists(
+        st.one_of(
+            st.tuples(st.just("write"), st.integers(0, 3), st.integers(0, 100)),
+            st.tuples(st.just("sync"), st.integers(0, 3), st.integers(0, 3)),
+        ),
+        max_size=20,
+    ),
+    st.data(),
+)
+def test_mvreg_convergence(script, data):
+    regs = [MVReg() for _ in ACTORS]
+    for ev in script:
+        if ev[0] == "write":
+            _, i, val = ev
+            regs[i].apply(regs[i].write_ctx(ACTORS[i], val))
+        else:
+            _, i, j = ev
+            regs[i].merge(regs[j])
+    # merge everything into one in two different orders
+    order = data.draw(st.permutations(range(len(regs))))
+    m1, m2 = MVReg(), MVReg()
+    for i in order:
+        m1.merge(regs[i])
+    for i in reversed(order):
+        m2.merge(regs[i])
+    assert canonical_bytes(m1) == canonical_bytes(m2)
+    m1.merge(m2)
+    assert canonical_bytes(m1) == canonical_bytes(m2)  # idempotent
+
+
+# ---- LWWMap --------------------------------------------------------------
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(0, 3),  # actor
+            st.integers(0, 2),  # key
+            st.integers(0, 20),  # ts
+            st.integers(0, 5),  # value
+            st.booleans(),  # tombstone
+        ),
+        max_size=25,
+    ),
+    st.data(),
+)
+def test_lwwmap_convergence(script, data):
+    ops = []
+    for actor_i, key_i, ts, val, tomb in script:
+        m = LWWMap()
+        op = (
+            m.delete(key_i, ts, ACTORS[actor_i])
+            if tomb
+            else m.put(key_i, ts, ACTORS[actor_i], val)
+        )
+        ops.append(op)
+    order = data.draw(st.permutations(range(len(ops))))
+    m1, m2 = LWWMap(), LWWMap()
+    for i in order:
+        m1.apply(ops[i])
+    for op in ops:
+        m2.apply(op)
+    assert canonical_bytes(m1) == canonical_bytes(m2)

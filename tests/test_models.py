@@ -1,0 +1,173 @@
+"""Unit semantics for the host-reference CRDT engine."""
+
+import uuid
+
+from crdt_enc_tpu.models import (
+    Dot,
+    EmptyCrdt,
+    GCounter,
+    LWWMap,
+    MVReg,
+    ORSet,
+    PNCounter,
+    RmOp,
+    VClock,
+    canonical_bytes,
+)
+
+A = uuid.UUID(int=1).bytes
+B = uuid.UUID(int=2).bytes
+C = uuid.UUID(int=3).bytes
+
+
+def test_vclock_basics():
+    v = VClock()
+    d = v.inc(A)
+    assert d == Dot(A, 1)
+    v.apply(d)
+    assert v.get(A) == 1 and v.contains(d)
+    v.apply(Dot(A, 1))  # idempotent
+    assert v.get(A) == 1
+    w = VClock({B: 3})
+    v.merge(w)
+    assert v.get(B) == 3
+    assert v.concurrent(VClock({C: 1}))
+    assert v.descends(VClock({A: 1}))
+    assert VClock({A: 2}).dominates(VClock({A: 1}))
+
+
+def test_gcounter():
+    g = GCounter()
+    g.apply(g.inc(A))
+    g.apply(g.inc(A))
+    g.apply(g.inc(B, steps=5))
+    assert g.read() == 7
+    h = GCounter.from_obj(g.to_obj())
+    assert h == g
+    g2 = GCounter()
+    g2.apply(Dot(A, 1))
+    g.merge(g2)  # older dot is a no-op
+    assert g.read() == 7
+
+
+def test_pncounter():
+    p = PNCounter()
+    p.apply(p.inc(A, 10))
+    p.apply(p.dec(B, 4))
+    assert p.read() == 6
+    assert PNCounter.from_obj(p.to_obj()) == p
+
+
+def test_orset_add_remove_readd():
+    s = ORSet()
+    s.apply(s.add_ctx(A, b"x"))
+    assert s.contains(b"x")
+    s.apply(s.rm_ctx(b"x"))
+    assert not s.contains(b"x")
+    s.apply(s.add_ctx(A, b"x"))
+    assert s.contains(b"x")
+    assert s.members() == [b"x"]
+
+
+def test_orset_remove_only_observed():
+    # A remove only kills the dots it saw: a concurrent re-add survives.
+    s1, s2 = ORSet(), ORSet()
+    add1 = s1.add_ctx(A, b"x")
+    s1.apply(add1)
+    s2.apply(add1)  # replicate
+    s2.clock.merge(VClock({A: 1}))
+    rm = s2.rm_ctx(b"x")  # observes only dot (A,1)
+    s2.apply(rm)
+    add2 = s1.add_ctx(A, b"x")  # concurrent re-add, dot (A,2)
+    s1.apply(add2)
+    s1.merge(s2)
+    assert s1.contains(b"x")  # add-wins for the unobserved dot
+    s2.apply(add2)
+    assert s2.contains(b"x")
+    assert canonical_bytes(s1) == canonical_bytes(s2)
+
+
+def test_orset_deferred_remove():
+    # Remove arrives before the adds it observed: must still win.
+    s = ORSet()
+    rm = RmOp(b"x", VClock({A: 2}))
+    s.apply(rm)
+    assert s.deferred  # recorded as pending
+    s.apply(ORSet().add_ctx(A, b"x"))  # dot (A,1) ≤ horizon: born dead
+    assert not s.contains(b"x")
+    a2 = ORSet()
+    a2.clock = VClock({A: 1})
+    s.apply(a2.add_ctx(A, b"x"))  # dot (A,2) = horizon: still dead
+    assert not s.contains(b"x")
+    assert not s.deferred  # horizon reached → pruned
+    a3 = ORSet()
+    a3.clock = VClock({A: 2})
+    s.apply(a3.add_ctx(A, b"x"))  # dot (A,3) > horizon: survives
+    assert s.contains(b"x")
+
+
+def test_orset_clock_filter_no_resurrection():
+    # After a state saw and removed a dot, merging an old state holding that
+    # dot must not resurrect it — the clock alone is the tombstone.
+    s1 = ORSet()
+    add = s1.add_ctx(A, b"x")
+    s1.apply(add)
+    old = ORSet()
+    old.apply(add)  # an old replica still holding the dot
+    s1.apply(s1.rm_ctx(b"x"))
+    assert not s1.deferred  # remove fully applied, no tombstone kept
+    s1.merge(old)
+    assert not s1.contains(b"x")
+    # and the other direction
+    old.merge(s1)
+    assert not old.contains(b"x")
+
+
+def test_mvreg_concurrent_then_supersede():
+    r1, r2 = MVReg(), MVReg()
+    r1.apply(r1.write_ctx(A, b"va"))
+    r2.apply(r2.write_ctx(B, b"vb"))
+    r1.merge(r2)
+    assert sorted(r1.read().values) == [b"va", b"vb"]  # concurrent: both live
+    # a write deriving from the merged read supersedes both
+    r1.apply(r1.write_ctx(A, b"vc"))
+    assert r1.read().values == [b"vc"]
+    r2.merge(r1)
+    assert r2.read().values == [b"vc"]
+    assert canonical_bytes(r1) == canonical_bytes(r2)
+
+
+def test_lwwmap():
+    m = LWWMap()
+    m.apply(m.put(b"k", 10, A, b"v1"))
+    m.apply(m.put(b"k", 5, B, b"old"))  # older ts loses
+    assert m.get(b"k") == b"v1"
+    m.apply(m.put(b"k", 10, B, b"tie"))  # ts tie → higher actor wins
+    assert m.get(b"k") == b"tie"
+    m.apply(m.delete(b"k", 11, A))
+    assert m.get(b"k") is None
+    assert m.keys() == []
+    m2 = LWWMap()
+    m2.apply(m2.put(b"k", 10, C, b"stale"))
+    m2.merge(m)
+    assert m2.get(b"k") is None  # tombstone wins over older put
+    assert canonical_bytes(m2) == canonical_bytes(m)
+
+
+def test_empty_crdt():
+    e = EmptyCrdt()
+    e.apply(None)
+    e.merge(EmptyCrdt())
+    assert EmptyCrdt.from_obj(e.to_obj()) == e
+
+
+def test_canonical_bytes_roundtrip():
+    s = ORSet()
+    s.apply(s.add_ctx(A, b"x"))
+    s.apply(s.add_ctx(B, (1, 2)))
+    s.apply(s.rm_ctx(b"x"))
+    blob = canonical_bytes(s)
+    from crdt_enc_tpu.utils import codec
+
+    s2 = ORSet.from_obj(codec.unpack(blob))
+    assert canonical_bytes(s2) == blob
