@@ -2,12 +2,15 @@ from .fs import FsStorage
 from .identity_crypto import IdentityCryptor
 from .memory import MemoryRemote, MemoryStorage, content_name
 from .plain_keys import PlainKeyCryptor
+from .xchacha import AeadError, XChaChaCryptor
 
 __all__ = [
+    "AeadError",
     "FsStorage",
     "IdentityCryptor",
     "MemoryRemote",
     "MemoryStorage",
     "PlainKeyCryptor",
+    "XChaChaCryptor",
     "content_name",
 ]

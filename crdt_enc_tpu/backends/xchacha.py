@@ -1,0 +1,82 @@
+"""XChaCha20-Poly1305 cryptor backend over the native C++ implementation.
+
+Wire format mirrors the reference cipher backend
+(crdt-enc-xchacha20poly1305/src/lib.rs:40-102): a 32-byte random key tagged
+with the key version; encrypt draws a random 24-byte XNonce, seals with
+XChaCha20-Poly1305, and wraps ``EncBox{nonce, enc_data}`` as msgpack inside a
+version-tagged envelope.  Crypto runs off the event loop in the default
+thread pool (the reference's spawn_blocking, lib.rs:30,48,81); the C call
+holds no Python state so threads scale to the pool width.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+
+from .. import native
+from ..core.cryptor import Cryptor
+from ..utils import VersionBytes, codec
+from ..utils.versions import XCHACHA_DATA_VERSION_1, XCHACHA_KEY_VERSION_1
+
+KEY_LEN = 32
+NONCE_LEN = 24
+TAG_LEN = 16
+
+
+class AeadError(Exception):
+    """Authentication failed: wrong key or tampered ciphertext."""
+
+
+def _check_key(key: bytes) -> None:
+    # the native code reads exactly 32 bytes; a short corrupt key blob must
+    # fail here, not read past the buffer (reference errors the same way,
+    # crdt-enc-xchacha20poly1305 lib.rs:43-45)
+    if len(key) != KEY_LEN:
+        raise AeadError(f"invalid key length {len(key)}; expected {KEY_LEN}")
+
+
+def encrypt_blob(key: bytes, data: bytes) -> bytes:
+    """Synchronous seal: data → raw-serialized versioned EncBox envelope."""
+    _check_key(key)
+    lib = native.load()
+    nonce = secrets.token_bytes(NONCE_LEN)
+    kp, _k = native.in_ptr(key)
+    np_, _n = native.in_ptr(nonce)
+    pp, _p = native.in_ptr(data)
+    op, out = native.out_buf(len(data) + TAG_LEN)
+    lib.xchacha20poly1305_encrypt(kp, np_, None, 0, pp, len(data), op)
+    box = codec.pack([nonce, out.tobytes()])
+    return VersionBytes(XCHACHA_DATA_VERSION_1, box).serialize()
+
+
+def decrypt_blob(key: bytes, blob: bytes) -> bytes:
+    """Synchronous open: raises AeadError on tag mismatch."""
+    _check_key(key)
+    lib = native.load()
+    vb = VersionBytes.deserialize(blob).ensure_version(XCHACHA_DATA_VERSION_1)
+    nonce, ct = codec.unpack(vb.content)
+    nonce, ct = bytes(nonce), bytes(ct)
+    if len(nonce) != NONCE_LEN or len(ct) < TAG_LEN:
+        raise AeadError("malformed EncBox")
+    kp, _k = native.in_ptr(key)
+    np_, _n = native.in_ptr(nonce)
+    cp, _c = native.in_ptr(ct)
+    op, out = native.out_buf(len(ct) - TAG_LEN)
+    rc = lib.xchacha20poly1305_decrypt(kp, np_, None, 0, cp, len(ct), op)
+    if rc != 0:
+        raise AeadError("authentication failed (wrong key or tampered data)")
+    return out.tobytes()
+
+
+class XChaChaCryptor(Cryptor):
+    async def gen_key(self) -> VersionBytes:
+        return VersionBytes(XCHACHA_KEY_VERSION_1, secrets.token_bytes(KEY_LEN))
+
+    async def encrypt(self, key: VersionBytes, data: bytes) -> bytes:
+        key.ensure_version(XCHACHA_KEY_VERSION_1)
+        return await asyncio.to_thread(encrypt_blob, key.content, data)
+
+    async def decrypt(self, key: VersionBytes, data: bytes) -> bytes:
+        key.ensure_version(XCHACHA_KEY_VERSION_1)
+        return await asyncio.to_thread(decrypt_blob, key.content, data)

@@ -31,15 +31,18 @@ class LWWOp:
         return cls(key, int(ts), bytes(actor), value, bool(tombstone))
 
 
-def _wins(a_ts: int, a_actor: bytes, a_val, b_ts: int, b_actor: bytes, b_val) -> bool:
+def _wins(a_ts, a_actor, a_val, a_tomb, b_ts, b_actor, b_val, b_tomb) -> bool:
     """True if write A beats write B.  Total order: ts, then actor bytes,
-    then canonical value bytes (so even pathological duplicate (ts, actor)
-    writes converge deterministically)."""
+    then canonical value bytes, then tombstone (delete wins a full tie) —
+    every duplicate-write pathology converges deterministically."""
     if a_ts != b_ts:
         return a_ts > b_ts
     if a_actor != b_actor:
         return a_actor > b_actor
-    return codec.pack(a_val) > codec.pack(b_val)
+    pa, pb = codec.pack(a_val), codec.pack(b_val)
+    if pa != pb:
+        return pa > pb
+    return a_tomb > b_tomb
 
 
 @dataclass
@@ -58,13 +61,13 @@ class LWWMap:
             op = LWWOp.from_obj(op)
         cur = self.entries.get(op.key)
         new = [op.ts, op.actor, None if op.tombstone else op.value, op.tombstone]
-        if cur is None or _wins(op.ts, op.actor, new[2], cur[0], cur[1], cur[2]):
+        if cur is None or _wins(*new, *cur):
             self.entries[op.key] = new
 
     def merge(self, other: "LWWMap") -> None:
         for key, theirs in other.entries.items():
             cur = self.entries.get(key)
-            if cur is None or _wins(theirs[0], theirs[1], theirs[2], cur[0], cur[1], cur[2]):
+            if cur is None or _wins(*theirs, *cur):
                 self.entries[key] = list(theirs)
 
     def get(self, key):

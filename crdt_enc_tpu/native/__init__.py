@@ -1,0 +1,84 @@
+"""ctypes loader for the native library (builds on demand via make)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "build", "libcrdtnative.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+u8p = ctypes.POINTER(ctypes.c_uint8)
+u64p = ctypes.POINTER(ctypes.c_uint64)
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        # always invoke make: an incremental no-op when fresh, and source
+        # edits never silently run stale native code
+        subprocess.run(["make", "-C", _HERE], check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+
+        lib.hchacha20.argtypes = [u8p, u8p, u8p]
+        lib.hchacha20.restype = None
+        for name in ("chacha20poly1305_encrypt", "xchacha20poly1305_encrypt"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                u8p, u8p, u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p
+            ]
+            fn.restype = None
+        for name in ("chacha20poly1305_decrypt", "xchacha20poly1305_decrypt"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                u8p, u8p, u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p
+            ]
+            fn.restype = ctypes.c_int
+        lib.xchacha20poly1305_decrypt_batch.argtypes = [
+            u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p, u64p, u8p
+        ]
+        lib.xchacha20poly1305_decrypt_batch.restype = ctypes.c_int
+
+        lib.orset_count_rows.argtypes = [u8p, ctypes.c_uint64]
+        lib.orset_count_rows.restype = ctypes.c_int64
+        lib.orset_decode.argtypes = [
+            u8p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int8), u64p, u64p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.orset_decode.restype = ctypes.c_int64
+        lib.counter_decode.argtypes = [
+            u8p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int8),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.counter_decode.restype = ctypes.c_int64
+
+        _lib = lib
+        return lib
+
+
+def in_ptr(b):
+    """Zero-copy input pointer for bytes/bytearray/ndarray.  The caller must
+    keep the object alive across the native call (numpy view held by the
+    returned tuple)."""
+    import numpy as np
+
+    arr = np.frombuffer(b, dtype=np.uint8) if not isinstance(b, np.ndarray) else b
+    if arr.size == 0:
+        return None, arr
+    return arr.ctypes.data_as(u8p), arr
+
+
+def out_buf(n: int):
+    """Writable output buffer of n bytes (numpy-backed)."""
+    import numpy as np
+
+    arr = np.empty(n, dtype=np.uint8)
+    return (arr.ctypes.data_as(u8p) if n else None), arr

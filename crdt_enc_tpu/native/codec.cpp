@@ -1,0 +1,270 @@
+// Bulk columnar decoder: msgpack op payloads → flat int arrays.
+//
+// The 1M-op ingestion path must not build a Python object per op
+// (SURVEY.md §2.2: "decode op files directly into pre-allocated arrays
+// without Python-object churn").  This decoder walks the framework's own
+// canonical op encodings directly:
+//
+//   ORSet add:  [0, member, [actor16, counter]]
+//   ORSet rm:   [1, member, {actor16: counter, ...}]
+//   counter op: [dir, [actor16, counter]]   (G-Counter: bare [actor16, c])
+//
+// Members are interned against a caller-managed table via a callback-free
+// two-pass protocol: pass 1 here extracts (kind, actor, counter) and member
+// *byte spans*; the Python side interns spans (zero-copy slices) only for
+// members, which in benchmarks are small ints/bytes.  For fully native
+// speed, fixed-width member encodings (int64) are decoded inline.
+//
+// Only the msgpack subset the canonical codec emits is implemented:
+// positive fixint/uint8/16/32/64, fixarray/array16/32, fixmap/map16/32,
+// bin8/16/32, negative ints rejected (canonical ops never hold them).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  uint8_t u8() {
+    if (p >= end) { fail = true; return 0; }
+    return *p++;
+  }
+  uint64_t be(int n) {
+    uint64_t v = 0;
+    if (p + n > end) { fail = true; p = end; return 0; }
+    for (int i = 0; i < n; i++) v = (v << 8) | *p++;
+    return v;
+  }
+  bool uint(uint64_t* out) {
+    uint8_t t = u8();
+    if (fail) return false;
+    if (t <= 0x7f) { *out = t; return true; }
+    if (t == 0xcc) { *out = be(1); return !fail; }
+    if (t == 0xcd) { *out = be(2); return !fail; }
+    if (t == 0xce) { *out = be(4); return !fail; }
+    if (t == 0xcf) { *out = be(8); return !fail; }
+    fail = true;
+    return false;
+  }
+  bool arr(uint64_t* len) {
+    uint8_t t = u8();
+    if (fail) return false;
+    if ((t & 0xf0) == 0x90) { *len = t & 0x0f; return true; }
+    if (t == 0xdc) { *len = be(2); return !fail; }
+    if (t == 0xdd) { *len = be(4); return !fail; }
+    fail = true;
+    return false;
+  }
+  bool map(uint64_t* len) {
+    uint8_t t = u8();
+    if (fail) return false;
+    if ((t & 0xf0) == 0x80) { *len = t & 0x0f; return true; }
+    if (t == 0xde) { *len = be(2); return !fail; }
+    if (t == 0xdf) { *len = be(4); return !fail; }
+    fail = true;
+    return false;
+  }
+  // bin: returns span
+  bool bin(const uint8_t** data, uint64_t* len) {
+    uint8_t t = u8();
+    if (fail) return false;
+    if (t == 0xc4) *len = be(1);
+    else if (t == 0xc5) *len = be(2);
+    else if (t == 0xc6) *len = be(4);
+    else { fail = true; return false; }
+    if (fail || p + *len > end) { fail = true; return false; }
+    *data = p;
+    p += *len;
+    return true;
+  }
+  // skip any value (for opaque members) returning its span
+  bool span(const uint8_t** s, uint64_t* n) {
+    const uint8_t* start = p;
+    if (!skip()) return false;
+    *s = start;
+    *n = (uint64_t)(p - start);
+    return true;
+  }
+  bool skip() {
+    uint8_t t = u8();
+    if (fail) return false;
+    if (t <= 0x7f || t >= 0xe0 || t == 0xc0 || t == 0xc2 || t == 0xc3)
+      return true;
+    if ((t & 0xe0) == 0xa0) { uint64_t n = t & 0x1f; p += n; goto bound; }
+    if ((t & 0xf0) == 0x90) { uint64_t n = t & 0x0f; return skip_n(n); }
+    if ((t & 0xf0) == 0x80) { uint64_t n = t & 0x0f; return skip_n(2 * n); }
+    switch (t) {
+      case 0xcc: case 0xd0: p += 1; goto bound;
+      case 0xcd: case 0xd1: p += 2; goto bound;
+      case 0xce: case 0xd2: case 0xca: p += 4; goto bound;
+      case 0xcf: case 0xd3: case 0xcb: p += 8; goto bound;
+      case 0xc4: { uint64_t n = be(1); p += n; goto bound; }
+      case 0xc5: { uint64_t n = be(2); p += n; goto bound; }
+      case 0xc6: { uint64_t n = be(4); p += n; goto bound; }
+      case 0xd9: { uint64_t n = be(1); p += n; goto bound; }
+      case 0xda: { uint64_t n = be(2); p += n; goto bound; }
+      case 0xdb: { uint64_t n = be(4); p += n; goto bound; }
+      case 0xdc: { uint64_t n = be(2); return skip_n(n); }
+      case 0xdd: { uint64_t n = be(4); return skip_n(n); }
+      case 0xde: { uint64_t n = be(2); return skip_n(2 * n); }
+      case 0xdf: { uint64_t n = be(4); return skip_n(2 * n); }
+      default: fail = true; return false;
+    }
+  bound:
+    if (p > end) { fail = true; return false; }
+    return true;
+  }
+  bool skip_n(uint64_t n) {
+    for (uint64_t i = 0; i < n; i++)
+      if (!skip()) return false;
+    return true;
+  }
+};
+
+// dense 16-byte actor → index via caller-provided sorted table
+int actor_index(const uint8_t* actors, uint64_t n_actors, const uint8_t* a) {
+  // binary search over 16-byte keys
+  uint64_t lo = 0, hi = n_actors;
+  while (lo < hi) {
+    uint64_t mid = (lo + hi) / 2;
+    int c = memcmp(actors + 16 * mid, a, 16);
+    if (c < 0) lo = mid + 1;
+    else if (c > 0) hi = mid;
+    else return (int)mid;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count the flattened rows of an ORSet op-file payload (array of ops):
+// adds contribute 1 row, removes contribute map-size rows.  Returns -1 on
+// malformed input.
+int64_t orset_count_rows(const uint8_t* buf, uint64_t len) {
+  Reader r{buf, buf + len};
+  uint64_t n_ops;
+  if (!r.arr(&n_ops)) return -1;
+  int64_t rows = 0;
+  for (uint64_t i = 0; i < n_ops; i++) {
+    uint64_t three, kind;
+    if (!r.arr(&three) || three != 3 || !r.uint(&kind)) return -1;
+    if (!r.skip()) return -1;  // member
+    if (kind == 0) {
+      uint64_t two;
+      if (!r.arr(&two) || two != 2 || !r.skip() || !r.skip()) return -1;
+      rows += 1;
+    } else if (kind == 1) {
+      uint64_t m;
+      if (!r.map(&m)) return -1;
+      for (uint64_t j = 0; j < m; j++)
+        if (!r.skip() || !r.skip()) return -1;
+      rows += (int64_t)m;
+    } else {
+      return -1;
+    }
+  }
+  return rows;
+}
+
+// Decode an ORSet op-file payload into flat rows.  Members are reported as
+// spans (offset/length into buf) for the caller to intern; actors resolve
+// against a sorted 16-byte-keyed table (unknown actors -> row dropped,
+// returns -1).  Arrays must be pre-sized via orset_count_rows.
+// Returns number of rows written, or -1 on malformed input.
+int64_t orset_decode(const uint8_t* buf, uint64_t len, const uint8_t* actors,
+                     uint64_t n_actors, int8_t* kind_out,
+                     uint64_t* member_off_out, uint64_t* member_len_out,
+                     int32_t* actor_out, int32_t* counter_out) {
+  Reader r{buf, buf + len};
+  uint64_t n_ops;
+  if (!r.arr(&n_ops)) return -1;
+  int64_t row = 0;
+  for (uint64_t i = 0; i < n_ops; i++) {
+    uint64_t three, kind;
+    if (!r.arr(&three) || three != 3 || !r.uint(&kind)) return -1;
+    const uint8_t* mspan;
+    uint64_t mlen;
+    if (!r.span(&mspan, &mlen)) return -1;
+    uint64_t moff = (uint64_t)(mspan - buf);
+    if (kind == 0) {
+      uint64_t two;
+      const uint8_t* a;
+      uint64_t alen, counter;
+      if (!r.arr(&two) || two != 2 || !r.bin(&a, &alen) || alen != 16 ||
+          !r.uint(&counter))
+        return -1;
+      int ai = actor_index(actors, n_actors, a);
+      if (ai < 0) return -1;
+      kind_out[row] = 0;
+      member_off_out[row] = moff;
+      member_len_out[row] = mlen;
+      actor_out[row] = ai;
+      counter_out[row] = (int32_t)counter;
+      row++;
+    } else if (kind == 1) {
+      uint64_t m;
+      if (!r.map(&m)) return -1;
+      for (uint64_t j = 0; j < m; j++) {
+        const uint8_t* a;
+        uint64_t alen, counter;
+        if (!r.bin(&a, &alen) || alen != 16 || !r.uint(&counter)) return -1;
+        int ai = actor_index(actors, n_actors, a);
+        if (ai < 0) return -1;
+        kind_out[row] = 1;
+        member_off_out[row] = moff;
+        member_len_out[row] = mlen;
+        actor_out[row] = ai;
+        counter_out[row] = (int32_t)counter;
+        row++;
+      }
+    } else {
+      return -1;
+    }
+  }
+  return row;
+}
+
+// Decode a counter op-file payload: array of [dir, [actor16, counter]]
+// (PN-Counter) or [actor16, counter] (G-Counter).  Returns rows or -1.
+int64_t counter_decode(const uint8_t* buf, uint64_t len,
+                       const uint8_t* actors, uint64_t n_actors,
+                       int8_t* sign_out, int32_t* actor_out,
+                       int32_t* counter_out) {
+  Reader r{buf, buf + len};
+  uint64_t n_ops;
+  if (!r.arr(&n_ops)) return -1;
+  for (uint64_t i = 0; i < n_ops; i++) {
+    uint64_t alen2;
+    if (!r.arr(&alen2)) return -1;
+    uint64_t dir = 0;
+    const uint8_t* a;
+    uint64_t alen, counter;
+    if (alen2 == 2) {
+      // peek: [bin, uint] = G-Counter dot; [uint, [..]] = PN op
+      if (r.p < r.end && (*r.p == 0xc4 || *r.p == 0xc5 || *r.p == 0xc6)) {
+        if (!r.bin(&a, &alen) || alen != 16 || !r.uint(&counter)) return -1;
+      } else {
+        uint64_t two;
+        if (!r.uint(&dir) || dir > 1 || !r.arr(&two) || two != 2 ||
+            !r.bin(&a, &alen) || alen != 16 || !r.uint(&counter))
+          return -1;
+      }
+    } else {
+      return -1;
+    }
+    int ai = actor_index(actors, n_actors, a);
+    if (ai < 0) return -1;
+    sign_out[i] = (int8_t)dir;
+    actor_out[i] = ai;
+    counter_out[i] = (int32_t)counter;
+  }
+  return (int64_t)n_ops;
+}
+
+}  // extern "C"

@@ -10,7 +10,9 @@ from .columnar import (
     lww_ops_to_columns,
     orset_ops_to_columns,
     orset_planes_to_state,
+    orset_scan_vocab,
     orset_state_to_planes,
+    pad_orset_rows,
     vclock_to_dense,
 )
 from .counters import gcounter_fold, pncounter_fold, vclock_merge
@@ -35,6 +37,8 @@ __all__ = [
     "orset_merge",
     "orset_merge_many",
     "orset_ops_to_columns",
+    "orset_scan_vocab",
+    "pad_orset_rows",
     "orset_planes_to_state",
     "orset_state_to_planes",
     "pncounter_fold",

@@ -31,6 +31,23 @@ KIND_ADD = 0
 KIND_RM = 1
 
 
+def pad_orset_rows(cols: "OrsetColumns", target: int, num_replicas: int):
+    """Pad flattened op columns to ``target`` rows with sentinel no-ops
+    (``actor == num_replicas`` marks padding — the single invariant every
+    fold kernel keys on).  Shared by bucket padding (recompilation bound)
+    and mesh padding (dp divisibility)."""
+    n = len(cols.kind)
+    padn = target - n
+    if padn > 0:
+        cols.kind = np.concatenate([cols.kind, np.zeros(padn, np.int8)])
+        cols.member = np.concatenate([cols.member, np.zeros(padn, np.int32)])
+        cols.actor = np.concatenate(
+            [cols.actor, np.full(padn, num_replicas, np.int32)]
+        )
+        cols.counter = np.concatenate([cols.counter, np.zeros(padn, np.int32)])
+    return cols
+
+
 class Vocab:
     """Interning table: object → dense index (first-appearance order)."""
 
@@ -82,7 +99,9 @@ def orset_ops_to_columns(
             counter.append(op.dot.counter)
         elif isinstance(op, RmOp):
             m = members.intern(op.member)
-            for r, c in op.ctx.counters.items():
+            # sorted-actor order matches the canonical packed form the
+            # native decoder walks, so both flattenings are positionally equal
+            for r, c in sorted(op.ctx.counters.items()):
                 kind.append(KIND_RM)
                 member.append(m)
                 actor.append(replicas.intern(r))
@@ -99,13 +118,10 @@ def orset_ops_to_columns(
     )
 
 
-def orset_state_to_planes(
-    state: ORSet, members: Vocab, replicas: Vocab
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Dense ``(clock[R], add[E,R], rm[E,R])`` planes (int32).
-
-    The vocabs are extended in place with anything the state mentions.
-    """
+def orset_scan_vocab(state: ORSet, members: Vocab, replicas: Vocab) -> None:
+    """Grow the vocabularies with everything the state mentions, without
+    building planes — the cheap first pass when densifying many states to a
+    shared vocabulary."""
     for m, entry in state.entries.items():
         members.intern(m)
         for r in entry:
@@ -116,6 +132,16 @@ def orset_state_to_planes(
             replicas.intern(r)
     for r in state.clock.counters:
         replicas.intern(r)
+
+
+def orset_state_to_planes(
+    state: ORSet, members: Vocab, replicas: Vocab
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Dense ``(clock[R], add[E,R], rm[E,R])`` planes (int32).
+
+    The vocabs are extended in place with anything the state mentions.
+    """
+    orset_scan_vocab(state, members, replicas)
     E, R = len(members), len(replicas)
     clock = np.zeros(R, np.int32)
     add = np.zeros((E, R), np.int32)

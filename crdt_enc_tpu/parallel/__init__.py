@@ -1,0 +1,15 @@
+from .accel import TpuAccelerator
+from .mesh import (
+    make_mesh,
+    orset_fold_sharded,
+    orset_merge_sharded,
+    pad_rows_for_mesh,
+)
+
+__all__ = [
+    "TpuAccelerator",
+    "make_mesh",
+    "orset_fold_sharded",
+    "orset_merge_sharded",
+    "pad_rows_for_mesh",
+]

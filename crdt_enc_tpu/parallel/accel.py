@@ -1,0 +1,203 @@
+"""TPU accelerator: the drop-in replacement for the core's host fold/merge.
+
+Plugs into ``OpenOptions.accelerator`` (crdt_enc_tpu/core/adapters.py
+defines the interface + the host reference implementation).  Each call
+converts sparse host state ↔ dense planes around one jitted kernel; the
+conversion cost is amortized over whole op batches, which is exactly the
+compaction shape (thousands of files → one fold).  Small batches fall back
+to the host loop — dispatch overhead would dominate.
+
+Shapes are bucket-padded (powers of two) so repeated compactions reuse
+compiled programs (SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.adapters import HostAccelerator
+from ..models import GCounter, LWWMap, ORSet, PNCounter
+from ..models.counters import NEG, POS
+from ..models.vclock import Dot, VClock
+from .. import ops as K
+
+MIN_DEVICE_BATCH = 256  # below this the host loop wins
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class TpuAccelerator(HostAccelerator):
+    """Accelerates ORSet / G-Counter / PN-Counter / LWW-Map; anything else
+    (MVReg, EmptyCrdt, custom types) falls back to the host loops."""
+
+    def __init__(self, min_device_batch: int = MIN_DEVICE_BATCH):
+        self.min_device_batch = min_device_batch
+
+    # ------------------------------------------------------------- fold_ops
+    def fold_ops(self, state, ops: list):
+        if len(ops) < self.min_device_batch:
+            return super().fold_ops(state, ops)
+        if isinstance(state, ORSet):
+            return self._fold_orset(state, ops)
+        if isinstance(state, PNCounter):
+            return self._fold_pncounter(state, ops)
+        if isinstance(state, GCounter):
+            return self._fold_gcounter(state, ops)
+        if isinstance(state, LWWMap):
+            return self._fold_lww(state, ops)
+        return super().fold_ops(state, ops)
+
+    def _fold_orset(self, state: ORSet, ops: list) -> ORSet:
+        members, replicas = K.Vocab(), K.Vocab()
+        cols = K.orset_ops_to_columns(ops, members, replicas)
+        clock0, add0, rm0 = K.orset_state_to_planes(state, members, replicas)
+        E, R = len(members), len(replicas)
+        if E == 0 or R == 0:
+            return state
+        K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
+        clock, add, rm = K.orset_fold(
+            clock0,
+            add0,
+            rm0,
+            cols.kind,
+            cols.member,
+            cols.actor,
+            cols.counter,
+            num_members=E,
+            num_replicas=R,
+        )
+        folded = K.orset_planes_to_state(
+            np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+        )
+        state.clock = folded.clock
+        state.entries = folded.entries
+        state.deferred = folded.deferred
+        return state
+
+    @staticmethod
+    def _pad_counter_cols(cols, num_replicas: int):
+        n = len(cols.sign)
+        padn = _bucket(n) - n
+        if padn:
+            cols.sign = np.concatenate([cols.sign, np.zeros(padn, np.int8)])
+            cols.actor = np.concatenate(
+                [cols.actor, np.full(padn, num_replicas, np.int32)]
+            )
+            cols.counter = np.concatenate([cols.counter, np.zeros(padn, np.int32)])
+        return cols
+
+    def _fold_gcounter(self, state: GCounter, ops: list) -> GCounter:
+        replicas = K.Vocab()
+        cols = K.counter_ops_to_columns(ops, replicas)
+        clock0 = K.vclock_to_dense(state.clock, replicas)
+        R = len(replicas)
+        self._pad_counter_cols(cols, R)
+        clock, _ = K.gcounter_fold(
+            clock0, cols.actor, cols.counter, num_replicas=R
+        )
+        state.clock = K.dense_to_vclock(np.asarray(clock), replicas)
+        return state
+
+    def _fold_pncounter(self, state: PNCounter, ops: list) -> PNCounter:
+        replicas = K.Vocab()
+        cols = K.counter_ops_to_columns(ops, replicas)
+        p0 = K.vclock_to_dense(state.p.clock, replicas)
+        n0 = K.vclock_to_dense(state.n.clock, replicas)
+        R = len(replicas)
+        if len(p0) < R:
+            p0 = np.pad(p0, (0, R - len(p0)))
+        if len(n0) < R:
+            n0 = np.pad(n0, (0, R - len(n0)))
+        self._pad_counter_cols(cols, R)
+        p, n, _ = K.pncounter_fold(
+            p0, n0, cols.sign, cols.actor, cols.counter, num_replicas=R
+        )
+        state.p.clock = K.dense_to_vclock(np.asarray(p), replicas)
+        state.n.clock = K.dense_to_vclock(np.asarray(n), replicas)
+        return state
+
+    def _fold_lww(self, state: LWWMap, ops: list) -> LWWMap:
+        cols = K.lww_ops_to_columns(ops)
+        Kn = len(cols.keys)
+        if Kn == 0:
+            return state
+        n = len(cols.key)
+        padn = _bucket(n) - n
+        key_col, hi, lo, actor_col, value_col = (
+            cols.key,
+            cols.ts_hi,
+            cols.ts_lo,
+            cols.actor,
+            cols.value,
+        )
+        if padn:
+            key_col = np.concatenate([key_col, np.full(padn, Kn, np.int32)])
+            hi = np.concatenate([hi, np.zeros(padn, np.int32)])
+            lo = np.concatenate([lo, np.zeros(padn, np.int32)])
+            actor_col = np.concatenate([actor_col, np.zeros(padn, np.int32)])
+            value_col = np.concatenate([value_col, np.zeros(padn, np.int32)])
+        m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
+            key_col, hi, lo, actor_col, value_col, num_keys=Kn
+        )
+        m_hi = np.asarray(m_hi)
+        m_lo = np.asarray(m_lo)
+        m_actor = np.asarray(m_actor)
+        m_value = np.asarray(m_value)
+        present = np.asarray(present)
+        # winner rows → tombstone lookup (vectorized over the batch)
+        ki = cols.key
+        win = (
+            (cols.ts_hi == m_hi[ki])
+            & (cols.ts_lo == m_lo[ki])
+            & (cols.actor == m_actor[ki])
+            & (cols.value == m_value[ki])
+        )
+        tomb_by_key = np.zeros(Kn, bool)
+        np.maximum.at(tomb_by_key, ki[win], cols.tombstone[win])
+        for k in range(Kn):
+            if not present[k]:
+                continue
+            ts = (int(m_hi[k]) << 31) | int(m_lo[k])
+            actor = cols.actors_sorted[int(m_actor[k])]
+            tomb = bool(tomb_by_key[k])
+            value = None if tomb else cols.values_sorted[int(m_value[k])]
+            # fold against any existing entry under host tie-break rules
+            state.apply(
+                state.delete(cols.keys.items[k], ts, actor)
+                if tomb
+                else state.put(cols.keys.items[k], ts, actor, value)
+            )
+        return state
+
+    # --------------------------------------------------------- merge_states
+    def merge_states(self, state, others: list):
+        if not others:
+            return state
+        if isinstance(state, ORSet) and len(others) + 1 >= 3:
+            return self._merge_orsets(state, others)
+        return super().merge_states(state, others)
+
+    def _merge_orsets(self, state: ORSet, others: list) -> ORSet:
+        members, replicas = K.Vocab(), K.Vocab()
+        all_states = [state] + list(others)
+        for s in all_states:
+            K.orset_scan_vocab(s, members, replicas)  # cheap vocab-only pass
+        if len(members) == 0 or len(replicas) == 0:
+            return state
+        planes = [K.orset_state_to_planes(s, members, replicas) for s in all_states]
+        clocks = np.stack([p[0] for p in planes])
+        adds = np.stack([p[1] for p in planes])
+        rms = np.stack([p[2] for p in planes])
+        clock, add, rm = K.orset_merge_many(clocks, adds, rms)
+        merged = K.orset_planes_to_state(
+            np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+        )
+        state.clock = merged.clock
+        state.entries = merged.entries
+        state.deferred = merged.deferred
+        return state

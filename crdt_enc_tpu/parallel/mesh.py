@@ -1,0 +1,163 @@
+"""Distributed fold/merge: SPMD over a device mesh.
+
+The scale-out story (SURVEY.md §2.3): op batches shard across the ``dp``
+axis (each device folds its slice of the flattened op rows) and the state
+planes shard across the ``mp`` axis (each device owns a contiguous member
+range of the (E, R) matrices — the "tensor parallel" analogue).  Because the
+fold is an elementwise-max semigroup, cross-device combination is a single
+``jax.lax.pmax`` over ``dp`` riding ICI — no parameter servers, no NCCL,
+exactly XLA collectives (the reference has no distributed backend at all;
+its transport is the synced filesystem, which this keeps untouched).
+
+Works on any mesh JAX can build: the one real TPU chip (1×1), a virtual
+8-CPU-device mesh in tests, or a multi-host TPU slice (devices spanning
+hosts — ``jax.distributed`` handles DCN bootstrap; the collectives here are
+oblivious to the host boundary).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import ops as K
+from ..ops.columnar import KIND_ADD, KIND_RM
+
+
+def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
+    """A (dp, mp) mesh over the available devices; defaults to all devices
+    on the dp axis."""
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices), 1)
+    dp, mp = shape
+    arr = np.asarray(devices[: dp * mp]).reshape(dp, mp)
+    return Mesh(arr, axis_names=("dp", "mp"))
+
+
+def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R):
+    """Per-device body: fold this device's op rows into its member slice.
+
+    ``member_lo`` is the first global member index of this device's slice;
+    rows outside the slice are masked (they belong to a different mp shard).
+    ``add0``/``rm0`` arrive as this device's (E_local, R) slice.
+    """
+    E_local = add0.shape[0]
+    pad = actor >= R
+    local_member = member - member_lo
+    in_slice = (local_member >= 0) & (local_member < E_local)
+    is_add = (kind == KIND_ADD) & ~pad & in_slice
+    is_rm = (kind == KIND_RM) & ~pad & in_slice
+    actor_ix = jnp.minimum(actor, R - 1)
+    member_ix = jnp.clip(local_member, 0, E_local - 1)
+
+    seen = counter <= clock0[actor_ix]
+    live_add = is_add & ~seen
+    seg = member_ix * R + actor_ix
+    add_new = jax.ops.segment_max(
+        jnp.where(live_add, counter, 0), seg, num_segments=E_local * R
+    )
+    rm_new = jax.ops.segment_max(
+        jnp.where(is_rm, counter, 0), seg, num_segments=E_local * R
+    )
+    add_new = jnp.maximum(add_new, 0).reshape(E_local, R)
+    rm_new = jnp.maximum(rm_new, 0).reshape(E_local, R)
+    clock_new = jnp.maximum(
+        jax.ops.segment_max(
+            jnp.where((kind == KIND_ADD) & ~pad & ~seen, counter, 0),
+            actor_ix,
+            num_segments=R,
+        ),
+        0,
+    )
+
+    # combine partials across the dp axis: max is the whole merge
+    add_new = jax.lax.pmax(add_new, "dp")
+    rm_new = jax.lax.pmax(rm_new, "dp")
+    clock_new = jax.lax.pmax(clock_new, "dp")
+
+    clock = jnp.maximum(clock0, clock_new)
+    add = jnp.maximum(add0, add_new)
+    rm = jnp.maximum(rm0, rm_new)
+    add = jnp.where(add > rm, add, 0)
+    rm = jnp.where(rm > clock[None, :], rm, 0)
+    return clock, add, rm
+
+
+def orset_fold_sharded(
+    mesh: Mesh,
+    clock0,
+    add0,
+    rm0,
+    kind,
+    member,
+    actor,
+    counter,
+):
+    """Sharded ORSet fold.
+
+    Layout: op rows sharded over ``dp`` (row count must divide by dp —
+    bucket-pad first); state planes sharded over ``mp`` on the member axis
+    (E must divide by mp); the clock is replicated (it is O(R) and every
+    shard updates it).  Returns (clock, add, rm) with the same shardings.
+    """
+    dp = mesh.shape["dp"]
+    mp = mesh.shape["mp"]
+    E, R = add0.shape
+    if len(kind) % dp or E % mp:
+        raise ValueError(
+            f"pad first: rows {len(kind)} % dp {dp} or members {E} % mp {mp}"
+        )
+    E_local = E // mp
+
+    def body(clock0, add0, rm0, kind, member, actor, counter, member_lo):
+        return _local_fold(
+            clock0, add0, rm0, kind, member, actor, counter, member_lo[0], R
+        )
+
+    # each mp shard needs its global member offset
+    member_lo = np.arange(mp, dtype=np.int32) * E_local
+
+    # op rows sharded over dp; plane member-axis sharded over mp
+    fold = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P("mp", None),
+            P("mp", None),
+            P("dp"),
+            P("dp"),
+            P("dp"),
+            P("dp"),
+            P("mp"),
+        ),
+        out_specs=(P(), P("mp", None), P("mp", None)),
+        check_vma=False,
+    )
+    return fold(clock0, add0, rm0, kind, member, actor, counter, member_lo)
+
+
+def orset_merge_sharded(mesh: Mesh, clock_a, add_a, rm_a, clock_b, add_b, rm_b):
+    """Pairwise state merge with planes sharded over mp — pure elementwise,
+    so the spec is trivial; exists to keep compaction fully SPMD."""
+
+    merge = jax.shard_map(
+        K.orset_merge,
+        mesh=mesh,
+        in_specs=(P(), P("mp", None), P("mp", None), P(), P("mp", None), P("mp", None)),
+        out_specs=(P(), P("mp", None), P("mp", None)),
+        check_vma=False,
+    )
+    return merge(clock_a, add_a, rm_a, clock_b, add_b, rm_b)
+
+
+def pad_rows_for_mesh(cols, dp: int, num_replicas: int):
+    """Pad flattened op columns so the row count divides the dp axis."""
+    n = len(cols.kind)
+    target = ((n + dp - 1) // dp) * dp
+    return K.pad_orset_rows(cols, target, num_replicas)

@@ -1,14 +1,33 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Benchmarks (bench.py) run on the real TPU in a separate process; tests
-exercise sharding/collectives on virtual CPU devices so they run anywhere.
+exercise sharding/collectives on virtual CPU devices so they run anywhere —
+including when the TPU tunnel is unavailable.
+
+Environment quirk: a sitecustomize hook imports jax eagerly in every
+interpreter and registers the axon TPU PJRT plugin, so mutating
+JAX_PLATFORMS here is too late — the config must be updated through the
+already-imported jax.  Backend *initialization* is still lazy, so forcing
+the platform list to "cpu" before any test touches a device keeps the (possibly
+unreachable) TPU tunnel entirely out of the test run.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PJRT_LIBRARY_PATH", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert not jax._src.xla_bridge._backends, (
+        "a backend initialized before conftest could force cpu; "
+        "tests would touch the TPU tunnel"
+    )

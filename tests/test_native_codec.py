@@ -1,0 +1,127 @@
+"""The C++ bulk op decoder must agree exactly with the Python columnar
+flattening over the canonical op encodings."""
+
+import ctypes
+import uuid
+
+import numpy as np
+
+from crdt_enc_tpu import native
+from crdt_enc_tpu import ops as K
+from crdt_enc_tpu.models import ORSet, PNCounter
+from crdt_enc_tpu.utils import codec
+
+ACTORS = [uuid.UUID(int=i + 1).bytes for i in range(4)]
+
+
+def decode_orset_native(payload: bytes, actors_sorted: list[bytes]):
+    lib = native.load()
+    bp, _b = native.in_ptr(payload)
+    n_rows = lib.orset_count_rows(bp, len(payload))
+    assert n_rows >= 0, "malformed payload"
+    actors_flat = b"".join(actors_sorted)
+    ap, _a = native.in_ptr(actors_flat)
+    kind = np.zeros(max(n_rows, 1), np.int8)
+    moff = np.zeros(max(n_rows, 1), np.uint64)
+    mlen = np.zeros(max(n_rows, 1), np.uint64)
+    actor = np.zeros(max(n_rows, 1), np.int32)
+    counter = np.zeros(max(n_rows, 1), np.int32)
+    rows = lib.orset_decode(
+        bp,
+        len(payload),
+        ap,
+        len(actors_sorted),
+        kind.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        moff.ctypes.data_as(native.u64p),
+        mlen.ctypes.data_as(native.u64p),
+        actor.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        counter.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    assert rows == n_rows
+    members = [
+        payload[int(moff[i]) : int(moff[i]) + int(mlen[i])] for i in range(rows)
+    ]
+    return kind[:rows], members, actor[:rows], counter[:rows]
+
+
+def test_orset_decode_matches_python():
+    state = ORSet()
+    ops = []
+    for i in range(40):
+        a = ACTORS[i % 4]
+        if i % 5 == 4:
+            op = state.rm_ctx(i % 3)
+            if op.ctx.is_empty():
+                continue
+        else:
+            op = state.add_ctx(a, i % 3)
+        state.apply(op)
+        ops.append(op)
+    payload = codec.pack([op.to_obj() for op in ops])
+
+    actors_sorted = sorted(ACTORS)
+    kind, members_raw, actor_ix, counter = decode_orset_native(
+        payload, actors_sorted
+    )
+
+    # python reference flattening
+    cols = K.orset_ops_to_columns(ops)
+    assert list(kind) == list(cols.kind)
+    assert list(counter) == list(cols.counter)
+    # native actor indices are into the sorted table
+    py_actors = [cols.replicas.items[i] for i in cols.actor]
+    nat_actors = [actors_sorted[i] for i in actor_ix]
+    assert py_actors == nat_actors
+    # native members are msgpack spans; decode and compare
+    py_members = [cols.members.items[i] for i in cols.member]
+    nat_members = [codec.unpack(m) for m in members_raw]
+    assert py_members == nat_members
+
+
+def test_orset_decode_rejects_malformed():
+    lib = native.load()
+    bad = codec.pack([[7, b"x", [b"a" * 16, 1]]])  # kind 7 does not exist
+    bp, _b = native.in_ptr(bad)
+    assert lib.orset_count_rows(bp, len(bad)) == -1
+    trunc = codec.pack([[0, b"x", [b"a" * 16, 1]]])[:-3]
+    tp, _t = native.in_ptr(trunc)
+    assert lib.orset_count_rows(tp, len(trunc)) == -1
+
+
+def test_counter_decode_matches_python():
+    state = PNCounter()
+    ops = []
+    for i in range(30):
+        a = ACTORS[i % 4]
+        op = state.inc(a, i % 3 + 1) if i % 2 else state.dec(a, 1)
+        state.apply(op)
+        ops.append(op)
+    from crdt_enc_tpu.core.adapters import pncounter_adapter
+
+    adapter = pncounter_adapter()
+    payload = codec.pack([adapter.op_to_obj(op) for op in ops])
+
+    lib = native.load()
+    actors_sorted = sorted(ACTORS)
+    bp, _b = native.in_ptr(payload)
+    ap, _a = native.in_ptr(b"".join(actors_sorted))
+    n = len(ops)
+    sign = np.zeros(n, np.int8)
+    actor = np.zeros(n, np.int32)
+    counter = np.zeros(n, np.int32)
+    rows = lib.counter_decode(
+        bp,
+        len(payload),
+        ap,
+        len(actors_sorted),
+        sign.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        actor.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        counter.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    assert rows == n
+    cols = K.counter_ops_to_columns(ops)
+    assert list(sign) == list(cols.sign)
+    assert list(counter) == list(cols.counter)
+    assert [cols.replicas.items[i] for i in cols.actor] == [
+        actors_sorted[i] for i in actor
+    ]
