@@ -1,0 +1,141 @@
+"""North-star benchmark: OR-Set compaction fold, TPU vs single-core host.
+
+Config #3 from BASELINE.md — 10k replicas / 1M add+remove ops — folded by
+the jitted ``orset_fold`` kernel (the TPU replacement for the reference's
+per-op host loop, crdt-enc/src/lib.rs:533-539).  The single-core baseline
+is this repo's host-reference ORSet (identical semantics, verified
+byte-identical on a subsample here and exhaustively in tests/).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = TPU ops merged/sec (post-compile); vs_baseline = speedup over the
+single-core host fold (host rate measured on a capped subsample of the
+same op stream — the host loop is O(n), so the per-op rate transfers).
+
+Env knobs: BENCH_OPS (1_000_000), BENCH_REPLICAS (10_000),
+BENCH_MEMBERS (4096), BENCH_HOST_OPS (100_000), BENCH_ITERS (3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def gen_columns(N: int, R: int, E: int, seed: int = 7):
+    """Vectorized op-stream generator: per-actor sequential add dots,
+    ~10% removes whose horizon is the actor's add-count so far."""
+    rng = np.random.default_rng(seed)
+    kind = (rng.random(N) < 0.10).astype(np.int8)
+    member = rng.integers(0, E, N, dtype=np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    is_add = kind == 0
+    # per-actor running count of adds, in row order (stable sort trick)
+    order = np.argsort(actor, kind="stable")
+    s_actor = actor[order]
+    s_isadd = is_add[order].astype(np.int64)
+    cum = np.cumsum(s_isadd)
+    starts = np.searchsorted(s_actor, np.arange(R))
+    base = np.where(starts < N, cum[np.minimum(starts, N - 1)] - s_isadd[np.minimum(starts, N - 1)], 0)
+    within = cum - base[s_actor]
+    counter = np.empty(N, np.int64)
+    counter[order] = within
+    counter = counter.astype(np.int32)
+    # removes before the actor ever added → sentinel padding rows
+    dead_rm = (~is_add) & (counter == 0)
+    actor = np.where(dead_rm, R, actor)
+    return kind, member, actor, counter
+
+
+def host_fold(kind, member, actor, counter, R: int):
+    """Single-core baseline: the host-reference ORSet applied op-by-op."""
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.models.orset import AddOp, RmOp
+    from crdt_enc_tpu.models.vclock import Dot, VClock
+
+    state = ORSet()
+    t0 = time.perf_counter()
+    for k, m, a, c in zip(kind.tolist(), member.tolist(), actor.tolist(), counter.tolist()):
+        if a >= R:
+            continue
+        if k == 0:
+            state.apply(AddOp(m, Dot(a, c)))
+        else:
+            state.apply(RmOp(m, VClock({a: c})))
+    return state, time.perf_counter() - t0
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    N = int(os.environ.get("BENCH_OPS", 50_000 if smoke else 1_000_000))
+    R = int(os.environ.get("BENCH_REPLICAS", 500 if smoke else 10_000))
+    E = int(os.environ.get("BENCH_MEMBERS", 256 if smoke else 4096))
+    N_HOST = min(N, int(os.environ.get("BENCH_HOST_OPS", 20_000 if smoke else 100_000)))
+    ITERS = int(os.environ.get("BENCH_ITERS", 3))
+
+    import jax
+
+    from crdt_enc_tpu import ops as K
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); N={N} R={R} E={E}")
+
+    kind, member, actor, counter = gen_columns(N, R, E)
+
+    # ---- correctness spot-check: host vs TPU byte equality on a subsample
+    n_chk = min(N, 20_000)
+    h_state, _ = host_fold(kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk], R)
+    from crdt_enc_tpu.ops.columnar import Vocab, orset_planes_to_state
+
+    mem_v = Vocab(range(E))
+    rep_v = Vocab(range(R))
+    c0 = np.zeros(R, np.int32)
+    a0 = np.zeros((E, R), np.int32)
+    r0 = np.zeros((E, R), np.int32)
+    ck, ad, rmv = K.orset_fold(
+        c0, a0, r0, kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk],
+        num_members=E, num_replicas=R,
+    )
+    t_state = orset_planes_to_state(np.asarray(ck), np.asarray(ad), np.asarray(rmv), mem_v, rep_v)
+    from crdt_enc_tpu.utils import codec
+
+    ok = codec.pack(t_state.to_obj()) == codec.pack(h_state.to_obj())
+    log(f"byte-equality (n={n_chk}): {'OK' if ok else 'MISMATCH'}")
+    if not ok:
+        log("WARNING: TPU fold diverged from host reference on subsample")
+
+    # ---- single-core host baseline (capped subsample; O(n) per-op loop)
+    _, t_host = host_fold(kind[:N_HOST], member[:N_HOST], actor[:N_HOST], counter[:N_HOST], R)
+    host_rate = N_HOST / t_host
+    log(f"host: {N_HOST} ops in {t_host:.3f}s → {host_rate:,.0f} ops/s")
+
+    # ---- TPU fold: full batch, compile excluded, ITERS timed runs
+    args = [jax.device_put(x, dev) for x in (c0, a0, r0, kind, member, actor, counter)]
+    fold = lambda: K.orset_fold(*args, num_members=E, num_replicas=R)
+    jax.block_until_ready(fold())  # compile + warmup
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fold())
+        times.append(time.perf_counter() - t0)
+    t_tpu = min(times)
+    tpu_rate = N / t_tpu
+    log(f"tpu: {N} ops in {t_tpu:.4f}s (best of {ITERS}) → {tpu_rate:,.0f} ops/s")
+
+    print(json.dumps({
+        "metric": "orset_compaction_fold_ops_per_sec",
+        "value": round(tpu_rate, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(tpu_rate / host_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,9 +54,14 @@ def decrypt_blob(key: bytes, blob: bytes) -> bytes:
     """Synchronous open: raises AeadError on tag mismatch."""
     _check_key(key)
     lib = native.load()
-    vb = VersionBytes.deserialize(blob).ensure_version(XCHACHA_DATA_VERSION_1)
-    nonce, ct = codec.unpack(vb.content)
-    nonce, ct = bytes(nonce), bytes(ct)
+    # any malformed framing is an auth failure to callers — attacker-shaped
+    # input must surface as AeadError, never a raw msgpack/codec exception
+    try:
+        vb = VersionBytes.deserialize(blob).ensure_version(XCHACHA_DATA_VERSION_1)
+        nonce, ct = codec.unpack(vb.content)
+        nonce, ct = bytes(nonce), bytes(ct)
+    except Exception as e:
+        raise AeadError(f"malformed EncBox: {e}") from e
     if len(nonce) != NONCE_LEN or len(ct) < TAG_LEN:
         raise AeadError("malformed EncBox")
     kp, _k = native.in_ptr(key)

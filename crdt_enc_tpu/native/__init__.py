@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import ctypes
+import fcntl
 import os
 import subprocess
 import threading
@@ -22,9 +23,25 @@ def load() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         # always invoke make: an incremental no-op when fresh, and source
-        # edits never silently run stale native code
-        subprocess.run(["make", "-C", _HERE], check=True, capture_output=True)
-        lib = ctypes.CDLL(_SO)
+        # edits never silently run stale native code.  A file lock serializes
+        # concurrent processes (the in-process _lock can't) so one never
+        # dlopens a half-linked .so.
+        os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)
+        with open(os.path.join(_HERE, "build", ".lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                subprocess.run(
+                    ["make", "-C", _HERE],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"native build failed (exit {e.returncode}):\n"
+                    f"{e.stdout}\n{e.stderr}"
+                ) from e
+            lib = ctypes.CDLL(_SO)
 
         lib.hchacha20.argtypes = [u8p, u8p, u8p]
         lib.hchacha20.restype = None

@@ -135,13 +135,16 @@ def orset_scan_vocab(state: ORSet, members: Vocab, replicas: Vocab) -> None:
 
 
 def orset_state_to_planes(
-    state: ORSet, members: Vocab, replicas: Vocab
+    state: ORSet, members: Vocab, replicas: Vocab, *, scanned: bool = False
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Dense ``(clock[R], add[E,R], rm[E,R])`` planes (int32).
 
-    The vocabs are extended in place with anything the state mentions.
+    The vocabs are extended in place with anything the state mentions;
+    pass ``scanned=True`` when ``orset_scan_vocab`` already ran for this
+    state (skips a redundant sparse pass).
     """
-    orset_scan_vocab(state, members, replicas)
+    if not scanned:
+        orset_scan_vocab(state, members, replicas)
     E, R = len(members), len(replicas)
     clock = np.zeros(R, np.int32)
     add = np.zeros((E, R), np.int32)

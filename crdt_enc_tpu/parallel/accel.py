@@ -189,7 +189,10 @@ class TpuAccelerator(HostAccelerator):
             K.orset_scan_vocab(s, members, replicas)  # cheap vocab-only pass
         if len(members) == 0 or len(replicas) == 0:
             return state
-        planes = [K.orset_state_to_planes(s, members, replicas) for s in all_states]
+        planes = [
+            K.orset_state_to_planes(s, members, replicas, scanned=True)
+            for s in all_states
+        ]
         clocks = np.stack([p[0] for p in planes])
         adds = np.stack([p[1] for p in planes])
         rms = np.stack([p[2] for p in planes])
