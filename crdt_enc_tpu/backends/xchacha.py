@@ -12,6 +12,7 @@ holds no Python state so threads scale to the pool width.
 from __future__ import annotations
 
 import asyncio
+import os
 import secrets
 
 from .. import native
@@ -74,6 +75,70 @@ def decrypt_blob(key: bytes, blob: bytes) -> bytes:
     return out.tobytes()
 
 
+def decrypt_blobs(key: bytes, blobs: list, n_threads: int = 0) -> list:
+    """Bulk open: unwrap every EncBox envelope, then one native threaded
+    batch call (GIL released for the whole stripe-parallel decrypt).
+    Raises AeadError if any blob fails authentication."""
+    import numpy as np
+
+    _check_key(key)
+    lib = native.load()
+    n = len(blobs)
+    if n == 0:
+        return []
+    if n_threads <= 0:
+        n_threads = min(32, os.cpu_count() or 1)
+    nonces = bytearray(NONCE_LEN * n)
+    cts = []
+    offsets = np.zeros(n + 1, np.uint64)
+    out_offsets = np.zeros(n, np.uint64)
+    total_ct = 0
+    for i, blob in enumerate(blobs):
+        try:
+            vb = VersionBytes.deserialize(blob).ensure_version(
+                XCHACHA_DATA_VERSION_1
+            )
+            nonce, ct = codec.unpack(vb.content)
+            nonce, ct = bytes(nonce), bytes(ct)
+        except Exception as e:
+            raise AeadError(f"malformed EncBox at index {i}: {e}") from e
+        if len(nonce) != NONCE_LEN or len(ct) < TAG_LEN:
+            raise AeadError(f"malformed EncBox at index {i}")
+        nonces[i * NONCE_LEN : (i + 1) * NONCE_LEN] = nonce
+        cts.append(ct)
+        out_offsets[i] = total_ct - TAG_LEN * i
+        total_ct += len(ct)
+        offsets[i + 1] = total_ct
+    ct_buf = b"".join(cts)
+    kp, _k = native.in_ptr(key)
+    np_, _n = native.in_ptr(bytes(nonces))
+    cp, _c = native.in_ptr(ct_buf)
+    op, out = native.out_buf(total_ct - TAG_LEN * n)
+    ok = np.zeros(n, np.uint8)
+    failures = lib.xchacha20poly1305_decrypt_batch_mt(
+        kp,
+        np_,
+        cp,
+        offsets.ctypes.data_as(native.u64p),
+        n,
+        op,
+        out_offsets.ctypes.data_as(native.u64p),
+        ok.ctypes.data_as(native.u8p),
+        n_threads,
+    )
+    if failures:
+        bad = int(np.flatnonzero(ok == 0)[0])
+        raise AeadError(
+            f"authentication failed on {failures}/{n} blobs (first: #{bad})"
+        )
+    res = []
+    for i in range(n):
+        lo = int(out_offsets[i])
+        hi = lo + (int(offsets[i + 1] - offsets[i]) - TAG_LEN)
+        res.append(out[lo:hi].tobytes())
+    return res
+
+
 class XChaChaCryptor(Cryptor):
     async def gen_key(self) -> VersionBytes:
         return VersionBytes(XCHACHA_KEY_VERSION_1, secrets.token_bytes(KEY_LEN))
@@ -85,3 +150,7 @@ class XChaChaCryptor(Cryptor):
     async def decrypt(self, key: VersionBytes, data: bytes) -> bytes:
         key.ensure_version(XCHACHA_KEY_VERSION_1)
         return await asyncio.to_thread(decrypt_blob, key.content, data)
+
+    async def decrypt_batch(self, key: VersionBytes, blobs: list) -> list:
+        key.ensure_version(XCHACHA_KEY_VERSION_1)
+        return await asyncio.to_thread(decrypt_blobs, key.content, blobs)

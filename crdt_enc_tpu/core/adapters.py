@@ -42,6 +42,13 @@ class HostAccelerator:
             state.merge(other)
         return state
 
+    def fold_payloads(self, state, payloads: list, actors_hint=()) -> bool:
+        """Fold raw decrypted op-file payloads (msgpack op arrays) without
+        per-op Python objects.  Returns True if handled; False tells the
+        caller to decode and use ``fold_ops`` (this host reference always
+        declines — the bulk path lives in the TPU accelerator)."""
+        return False
+
 
 @dataclass
 class CrdtAdapter:

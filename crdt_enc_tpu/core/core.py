@@ -44,6 +44,7 @@ from .key_cryptor import Key, KeyCryptor, Keys
 from .storage import Storage
 
 IO_CONCURRENCY = 16  # bounded pipeline width (reference lib.rs:452,512)
+BULK_MIN_FILES = 16  # below this the per-file asyncio path is cheaper
 
 
 class CoreError(Exception):
@@ -383,6 +384,11 @@ class Core:
         files = await self.storage.load_ops(wanted)
         if not files:
             return
+        if len(files) >= BULK_MIN_FILES:
+            # streaming front end: batched native decrypt + columnar decode
+            # (SURVEY.md §7 step 6); falls through on structural surprises
+            if await self._read_remote_ops_bulk(files, actors):
+                return
         sem = asyncio.Semaphore(IO_CONCURRENCY)
 
         async def decode(actor: Actor, version: int, raw: bytes):
@@ -408,6 +414,71 @@ class Core:
             self._data.next_op_versions.apply(Dot(actor, version))
         if batch:
             self.accel.fold_ops(self._data.state, batch)
+
+    async def _read_remote_ops_bulk(self, files: list, actors) -> bool:
+        """Bulk ingestion: unwrap all outer envelopes, one batched decrypt
+        per sealing key, then hand raw payloads to the accelerator's
+        columnar decode+fold.  Returns False (nothing consumed) when the
+        outer framing surprises us, so the per-file path can produce its
+        precise error; key-auth and op-order violations raise exactly as
+        the per-file path would (lib.rs:519-531 semantics preserved)."""
+        try:
+            key_ids, middles = [], []
+            for _, _, raw in files:
+                outer = VersionBytes.deserialize(raw).ensure_versions(
+                    SUPPORTED_CONTAINER_VERSIONS
+                )
+                kid, middle = codec.unpack(outer.content)
+                key_ids.append(bytes(kid))
+                middles.append(bytes(middle))
+        except Exception:
+            return False
+        groups: dict[bytes, list[int]] = {}
+        for i, kid in enumerate(key_ids):
+            groups.setdefault(kid, []).append(i)
+        clears: list = [None] * len(files)
+        for kid, idxs in groups.items():
+            key = self._data.keys.get_key(kid)
+            if key is None:
+                raise MissingKeyError(
+                    f"ops sealed with unknown key {uuid.UUID(bytes=kid)}; "
+                    "key metadata may not have synced yet"
+                )
+            outs = await self.cryptor.decrypt_batch(
+                key.material, [middles[i] for i in idxs]
+            )
+            for i, clear in zip(idxs, outs):
+                clears[i] = clear
+
+        # sync section: inner version checks + ordered bookkeeping + fold
+        payloads = []
+        for (actor, version, _), clear in zip(files, clears):
+            expected = self._data.next_op_versions.get(actor) + 1
+            if version < expected:
+                continue  # concurrent-read tolerance (lib.rs:521-525)
+            if version > expected:
+                raise OpOrderError(
+                    f"op file v{version} for {uuid.UUID(bytes=actor)} arrived "
+                    f"beyond expected v{expected}"
+                )
+            inner = VersionBytes.deserialize(clear).ensure_versions(
+                self.supported_data_versions
+            )
+            payloads.append(inner.content)
+            self._data.next_op_versions.apply(Dot(actor, version))
+        if not payloads:
+            return True
+        if self.accel.fold_payloads(
+            self._data.state, payloads, actors_hint=actors
+        ):
+            return True
+        # accelerator declined (non-columnar CRDT): decode per-op in Python
+        # but still fold as one batch
+        batch = []
+        for p in payloads:
+            batch.extend(self.adapter.op_from_obj(o) for o in codec.unpack(p))
+        self.accel.fold_ops(self._data.state, batch)
+        return True
 
     # --------------------------------------------------------------- compact
     async def compact(self) -> None:

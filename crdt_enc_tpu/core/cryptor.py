@@ -26,6 +26,12 @@ class Cryptor(ABC):
     async def decrypt(self, key: VersionBytes, data: bytes) -> bytes:
         """Open a cipher envelope produced by ``encrypt``."""
 
+    async def decrypt_batch(self, key: VersionBytes, blobs: list) -> list:
+        """Open many envelopes sealed with one key.  Default: sequential
+        loop; bulk backends override with a parallel native path (the
+        decrypt front end of streaming compaction, SURVEY.md §7 step 6)."""
+        return [await self.decrypt(key, b) for b in blobs]
+
     async def init(self, core) -> None: ...
 
     async def set_remote_meta(self, meta) -> None: ...

@@ -61,6 +61,11 @@ def load() -> ctypes.CDLL:
             u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p, u64p, u8p
         ]
         lib.xchacha20poly1305_decrypt_batch.restype = ctypes.c_int
+        lib.xchacha20poly1305_decrypt_batch_mt.argtypes = [
+            u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p, u64p, u8p,
+            ctypes.c_int,
+        ]
+        lib.xchacha20poly1305_decrypt_batch_mt.restype = ctypes.c_int
 
         lib.orset_count_rows.argtypes = [u8p, ctypes.c_uint64]
         lib.orset_count_rows.restype = ctypes.c_int64

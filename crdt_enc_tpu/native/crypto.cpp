@@ -15,6 +15,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -337,6 +339,46 @@ int xchacha20poly1305_decrypt_batch(const uint8_t* key, const uint8_t* nonces,
     ok_flags[i] = rc == 0 ? 1 : 0;
     if (rc != 0) failures++;
   }
+  return failures;
+}
+
+// Threaded batch decrypt: blobs are independent (per-blob nonce, disjoint
+// output spans), so stripes shard freely across threads.  The Python caller
+// releases the GIL for the whole call (ctypes does this automatically).
+int xchacha20poly1305_decrypt_batch_mt(const uint8_t* key,
+                                       const uint8_t* nonces,
+                                       const uint8_t* cts,
+                                       const uint64_t* offsets, uint64_t n,
+                                       uint8_t* out,
+                                       const uint64_t* out_offsets,
+                                       uint8_t* ok_flags, int n_threads) {
+  if (n_threads <= 1 || n < 2)
+    return xchacha20poly1305_decrypt_batch(key, nonces, cts, offsets, n, out,
+                                           out_offsets, ok_flags);
+  if ((uint64_t)n_threads > n) n_threads = (int)n;
+  std::vector<std::thread> workers;
+  std::vector<int> fails((size_t)n_threads, 0);
+  uint64_t stride = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    uint64_t lo = t * stride;
+    uint64_t hi = lo + stride < n ? lo + stride : n;
+    if (lo >= hi) break;
+    workers.emplace_back([=, &fails]() {
+      int f = 0;
+      for (uint64_t i = lo; i < hi; i++) {
+        const uint8_t* ct = cts + offsets[i];
+        uint64_t ct_len = offsets[i + 1] - offsets[i];
+        int rc = xchacha20poly1305_decrypt(key, nonces + 24 * i, nullptr, 0,
+                                           ct, ct_len, out + out_offsets[i]);
+        ok_flags[i] = rc == 0 ? 1 : 0;
+        if (rc != 0) f++;
+      }
+      fails[t] = f;
+    });
+  }
+  for (auto& w : workers) w.join();
+  int failures = 0;
+  for (int f : fails) failures += f;
   return failures;
 }
 

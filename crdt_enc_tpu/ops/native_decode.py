@@ -1,0 +1,171 @@
+"""Batched native decode: decrypted op payloads → columnar arrays.
+
+The bulk front end (SURVEY.md §7 step 6, §2.2 "decode op files directly
+into pre-allocated arrays without Python-object churn"): each payload is
+the msgpack body of one op file; the C++ decoder flattens every payload
+into shared (kind, member-span, actor, counter) arrays, and member spans
+are interned *vectorized* — grouped by span length, ``np.unique(axis=0)``
+over byte matrices — so no per-row Python executes on the million-op path.
+
+Returns None when a payload defeats the native decoder (unknown actor,
+non-canonical encoding); callers fall back to the per-op Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .. import native
+from ..utils import codec
+from .columnar import Vocab
+
+_i8p = ctypes.POINTER(ctypes.c_int8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+def decode_orset_payload_batch(payloads: list, actors_sorted: list):
+    """Decode many ORSet op payloads against a sorted actor table.
+
+    Returns ``(kind, member_idx, actor_idx, counter, members)`` — flat
+    int arrays over all payloads' rows plus the interned member-object
+    list (first-appearance order) — or None to request Python fallback.
+    """
+    lib = native.load()
+    if not payloads:
+        return (
+            np.zeros(0, np.int8),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            [],
+        )
+    big = b"".join(payloads)
+    buf = np.frombuffer(big, np.uint8)
+    bp = buf.ctypes.data_as(native.u8p)
+    actors_flat = b"".join(actors_sorted)
+    ap, _a = native.in_ptr(actors_flat)
+
+    # pass 1: row counts (also validates framing)
+    bases = np.zeros(len(payloads) + 1, np.int64)
+    counts = np.zeros(len(payloads), np.int64)
+    off = 0
+    for i, p in enumerate(payloads):
+        bases[i] = off
+        n = lib.orset_count_rows(
+            buf[off:].ctypes.data_as(native.u8p), len(p)
+        )
+        if n < 0:
+            return None
+        counts[i] = n
+        off += len(p)
+    bases[len(payloads)] = off
+    total = int(counts.sum())
+    if total == 0:
+        return (
+            np.zeros(0, np.int8),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            [],
+        )
+
+    kind = np.zeros(total, np.int8)
+    moff = np.zeros(total, np.uint64)
+    mlen = np.zeros(total, np.uint64)
+    actor = np.zeros(total, np.int32)
+    counter = np.zeros(total, np.int32)
+
+    # pass 2: decode each payload into its row slice
+    row = 0
+    for i, p in enumerate(payloads):
+        n = int(counts[i])
+        if n == 0:
+            continue
+        got = lib.orset_decode(
+            buf[int(bases[i]) :].ctypes.data_as(native.u8p),
+            len(p),
+            ap,
+            len(actors_sorted),
+            kind[row:].ctypes.data_as(_i8p),
+            moff[row:].ctypes.data_as(native.u64p),
+            mlen[row:].ctypes.data_as(native.u64p),
+            actor[row:].ctypes.data_as(_i32p),
+            counter[row:].ctypes.data_as(_i32p),
+        )
+        if got != n:
+            return None
+        moff[row : row + n] += np.uint64(bases[i])
+        row += n
+
+    member_idx, members = intern_spans(buf, moff, mlen)
+    return kind, member_idx, actor, counter, members
+
+
+def intern_spans(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
+    """Vectorized span interning: rows → dense member indices + decoded
+    unique member objects.  Groups rows by span length; within a group the
+    spans become an (n, L) byte matrix and ``np.unique`` assigns ids."""
+    n = len(off)
+    member_idx = np.zeros(n, np.int32)
+    members: list = []
+    off = off.astype(np.int64)
+    length = length.astype(np.int64)
+    for L in np.unique(length):
+        Li = int(L)
+        sel = np.flatnonzero(length == L)
+        if Li == 0:
+            # zero-length span cannot be valid msgpack; caller's decoder
+            # never emits it, but guard anyway
+            raise ValueError("empty member span")
+        # gather rows × L bytes in one fancy index
+        mat = buf[off[sel][:, None] + np.arange(Li)[None, :]]
+        uniq, inv = np.unique(mat, axis=0, return_inverse=True)
+        base = len(members)
+        for u in uniq:
+            members.append(codec.unpack(u.tobytes()))
+        member_idx[sel] = base + inv.astype(np.int32)
+    return member_idx, members
+
+
+def decode_counter_payload_batch(payloads: list, actors_sorted: list):
+    """Decode many counter op payloads.  Returns ``(sign, actor_idx,
+    counter)`` flat arrays or None for Python fallback."""
+    lib = native.load()
+    if not payloads:
+        return np.zeros(0, np.int8), np.zeros(0, np.int32), np.zeros(0, np.int32)
+    big = b"".join(payloads)
+    buf = np.frombuffer(big, np.uint8)
+    actors_flat = b"".join(actors_sorted)
+    ap, _a = native.in_ptr(actors_flat)
+
+    signs, actors, counters = [], [], []
+    off = 0
+    for p in payloads:
+        # counter payloads are op arrays: rows == top-level array length,
+        # obtained by decoding directly (counter_decode validates fully)
+        cap = max(len(p), 1)  # rows ≤ payload bytes
+        sign = np.zeros(cap, np.int8)
+        actor = np.zeros(cap, np.int32)
+        counter = np.zeros(cap, np.int32)
+        got = lib.counter_decode(
+            buf[off:].ctypes.data_as(native.u8p),
+            len(p),
+            ap,
+            len(actors_sorted),
+            sign.ctypes.data_as(_i8p),
+            actor.ctypes.data_as(_i32p),
+            counter.ctypes.data_as(_i32p),
+        )
+        if got < 0:
+            return None
+        signs.append(sign[:got])
+        actors.append(actor[:got])
+        counters.append(counter[:got])
+        off += len(p)
+    return (
+        np.concatenate(signs),
+        np.concatenate(actors),
+        np.concatenate(counters),
+    )

@@ -55,10 +55,20 @@ class TpuAccelerator(HostAccelerator):
     def _fold_orset(self, state: ORSet, ops: list) -> ORSet:
         members, replicas = K.Vocab(), K.Vocab()
         cols = K.orset_ops_to_columns(ops, members, replicas)
+        return self._fold_orset_columns(
+            state, cols.kind, cols.member, cols.actor, cols.counter,
+            members, replicas,
+        )
+
+    def _fold_orset_columns(
+        self, state: ORSet, kind, member, actor, counter, members, replicas
+    ) -> ORSet:
+        """Shared tail: state → planes, pad, jit fold, planes → state."""
         clock0, add0, rm0 = K.orset_state_to_planes(state, members, replicas)
         E, R = len(members), len(replicas)
         if E == 0 or R == 0:
             return state
+        cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
         K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
         clock, add, rm = K.orset_fold(
             clock0,
@@ -78,6 +88,37 @@ class TpuAccelerator(HostAccelerator):
         state.entries = folded.entries
         state.deferred = folded.deferred
         return state
+
+    # -------------------------------------------------------- fold_payloads
+    def fold_payloads(self, state, payloads: list, actors_hint=()) -> bool:
+        """Bulk front end: decrypted op-file payloads → native columnar
+        decode → jit fold.  Handles ORSet; anything else (or any payload
+        the native decoder declines) falls back to the per-op path."""
+        if not isinstance(state, ORSet):
+            return False
+        from ..ops.native_decode import decode_orset_payload_batch
+
+        actor_set = set(actors_hint)
+        actor_set.update(state.clock.counters)
+        for entry in state.entries.values():
+            actor_set.update(entry)
+        for dfr in state.deferred.values():
+            actor_set.update(dfr)
+        actors_sorted = sorted(actor_set)
+        decoded = decode_orset_payload_batch(payloads, actors_sorted)
+        if decoded is None:
+            return False
+        kind, member_idx, actor_idx, counter, member_objs = decoded
+        if len(kind) == 0:
+            return True
+        # vocabs: replicas in the decoder's sorted order; members in the
+        # decoder's intern order (state members appended by planes builder)
+        members = K.Vocab(member_objs)
+        replicas = K.Vocab(actors_sorted)
+        self._fold_orset_columns(
+            state, kind, member_idx, actor_idx, counter, members, replicas
+        )
+        return True
 
     @staticmethod
     def _pad_counter_cols(cols, num_replicas: int):
