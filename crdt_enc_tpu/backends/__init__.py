@@ -1,6 +1,7 @@
 from .fs import FsStorage
 from .identity_crypto import IdentityCryptor
 from .memory import MemoryRemote, MemoryStorage, content_name
+from .passphrase_keys import PassphraseKeyCryptor, WrongPassphrase
 from .plain_keys import PlainKeyCryptor
 from .xchacha import AeadError, XChaChaCryptor
 
@@ -10,7 +11,9 @@ __all__ = [
     "IdentityCryptor",
     "MemoryRemote",
     "MemoryStorage",
+    "PassphraseKeyCryptor",
     "PlainKeyCryptor",
+    "WrongPassphrase",
     "XChaChaCryptor",
     "content_name",
 ]

@@ -19,6 +19,11 @@ from ..utils.versions import KEYS_META_VERSION_1, SUPPORTED_KEYS_META_VERSIONS
 
 
 class PlainKeyCryptor(KeyCryptor):
+    # Subclasses that really protect the blob stamp their own meta version so
+    # a reader with the wrong backend fails the version check, not the parse.
+    META_VERSION = KEYS_META_VERSION_1
+    SUPPORTED_META_VERSIONS = SUPPORTED_KEYS_META_VERSIONS
+
     def __init__(self):
         self._reg = MVReg()
         self._core = None
@@ -39,7 +44,7 @@ class PlainKeyCryptor(KeyCryptor):
         Keys CRDT, install on the core (gpgme lib.rs:79-105)."""
         self._reg.merge(reg)
         keys = await decode_version_bytes_mvreg(
-            self._reg, SUPPORTED_KEYS_META_VERSIONS, Keys, transform=self._unprotect
+            self._reg, self.SUPPORTED_META_VERSIONS, Keys, transform=self._unprotect
         )
         if keys is not None and self._core is not None:
             self._core.set_keys(keys)
@@ -53,7 +58,7 @@ class PlainKeyCryptor(KeyCryptor):
             self._reg,
             keys,
             self._core.actor_id,
-            KEYS_META_VERSION_1,
+            self.META_VERSION,
             transform=self._protect,
         )
         snapshot = MVReg.from_obj(self._reg.to_obj())

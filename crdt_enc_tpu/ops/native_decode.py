@@ -19,7 +19,6 @@ import numpy as np
 
 from .. import native
 from ..utils import codec
-from .columnar import Vocab
 
 _i8p = ctypes.POINTER(ctypes.c_int8)
 _i32p = ctypes.POINTER(ctypes.c_int32)

@@ -92,8 +92,11 @@ class TpuAccelerator(HostAccelerator):
     # -------------------------------------------------------- fold_payloads
     def fold_payloads(self, state, payloads: list, actors_hint=()) -> bool:
         """Bulk front end: decrypted op-file payloads → native columnar
-        decode → jit fold.  Handles ORSet; anything else (or any payload
-        the native decoder declines) falls back to the per-op path."""
+        decode → jit fold.  Handles ORSet and the two counters; anything
+        else (or any payload the native decoder declines) falls back to
+        the per-op path."""
+        if isinstance(state, (GCounter, PNCounter)):
+            return self._fold_counter_payloads(state, payloads, actors_hint)
         if not isinstance(state, ORSet):
             return False
         from ..ops.native_decode import decode_orset_payload_batch
@@ -115,9 +118,61 @@ class TpuAccelerator(HostAccelerator):
         # decoder's intern order (state members appended by planes builder)
         members = K.Vocab(member_objs)
         replicas = K.Vocab(actors_sorted)
+        # Vocab interning hashes member *objects*; distinct canonical bytes
+        # can still collide as Python values (1 == True, 0.0 == -0.0).  A
+        # collapsed vocab would leave member_idx out of range and scatter
+        # ops onto the wrong member — bail to the per-op path instead.
+        if len(members) != len(member_objs):
+            return False
         self._fold_orset_columns(
             state, kind, member_idx, actor_idx, counter, members, replicas
         )
+        return True
+
+    def _fold_counter_payloads(self, state, payloads: list, actors_hint=()) -> bool:
+        """Counter bulk path: native decode straight to (sign, actor,
+        counter) columns, one segment-max fold.  Dots are monotone per
+        actor, so max-folding whole files at once equals per-op apply."""
+        from ..ops.native_decode import decode_counter_payload_batch
+
+        clocks = (
+            (state.p.clock, state.n.clock)
+            if isinstance(state, PNCounter)
+            else (state.clock,)
+        )
+        actor_set = set(actors_hint)
+        for c in clocks:
+            actor_set.update(c.counters)
+        actors_sorted = sorted(actor_set)
+        decoded = decode_counter_payload_batch(payloads, actors_sorted)
+        if decoded is None:
+            return False
+        sign, actor_idx, counter = decoded
+        if len(sign) == 0:
+            return True
+        replicas = K.Vocab(actors_sorted)
+        R = len(replicas)
+        n = len(sign)
+        cols = self._pad_counter_cols(
+            K.CounterColumns(sign, actor_idx, counter, replicas), R
+        )
+        sign, actor_idx, counter = cols.sign, cols.actor, cols.counter
+        if isinstance(state, PNCounter):
+            p0 = K.vclock_to_dense(state.p.clock, replicas)
+            n0 = K.vclock_to_dense(state.n.clock, replicas)
+            p, nn, _ = K.pncounter_fold(
+                p0, n0, sign, actor_idx, counter, num_replicas=R
+            )
+            state.p.clock = K.dense_to_vclock(np.asarray(p), replicas)
+            state.n.clock = K.dense_to_vclock(np.asarray(nn), replicas)
+        else:
+            if np.any(sign[:n] != POS):  # PN-shaped rows in a G-Counter state
+                return False
+            clock0 = K.vclock_to_dense(state.clock, replicas)
+            clock, _ = K.gcounter_fold(
+                clock0, actor_idx, counter, num_replicas=R
+            )
+            state.clock = K.dense_to_vclock(np.asarray(clock), replicas)
         return True
 
     @staticmethod

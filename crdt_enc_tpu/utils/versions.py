@@ -31,6 +31,15 @@ IDENTITY_KEY_VERSION_1 = uuid.UUID("e91a3c56-7d20-4b8f-a6e1-48c5d90b2f05").bytes
 KEYS_META_VERSION_1 = uuid.UUID("27c6e0f9-15ab-4d72-8c43-6e9f01d5ba06").bytes
 SUPPORTED_KEYS_META_VERSIONS = frozenset({KEYS_META_VERSION_1})
 
+# Passphrase-wrapped key-cryptor remote-meta format: the Keys blob sealed
+# under a scrypt-derived key (salt + KDF params + XChaCha EncBox envelope).
+PASSPHRASE_KEYS_META_VERSION_1 = uuid.UUID(
+    "9d84f2a1-6b0e-4c57-a3d9-0f72e85c4b08"
+).bytes
+SUPPORTED_PASSPHRASE_KEYS_META_VERSIONS = frozenset(
+    {PASSPHRASE_KEYS_META_VERSION_1}
+)
+
 # Application-data versions are *not* fixed here: like the reference's
 # OpenOptions.supported_data_versions (lib.rs:730-731) they are chosen by the
 # application that owns the CRDT state type.  A reasonable default for tests:

@@ -1,0 +1,71 @@
+"""End-to-end example — the rebuild of the reference's example app
+(examples/test/src/main.rs:12-57): assemble the real backends (filesystem
+storage + XChaCha20-Poly1305 cryptor + passphrase key cryptor), open a
+replica holding an ``MVReg`` of integers, ingest whatever other replicas
+left in the shared remote, then write ``max(values) + 1``.
+
+Unlike the reference's example this one also exercises ``compact`` (there it
+is commented out, main.rs:41 — its compaction path had a write/read format
+asymmetry, SURVEY.md §3.4; ours round-trips).
+
+Run it twice with the same --data dir and watch the value climb; point two
+different --local names at one shared remote to emulate two synced devices:
+
+    python examples/counter_sync.py --data ./data --local dev-a
+    python examples/counter_sync.py --data ./data --local dev-b
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from crdt_enc_tpu.backends import FsStorage, PassphraseKeyCryptor, XChaChaCryptor
+from crdt_enc_tpu.core import Core, OpenOptions, mvreg_adapter
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+async def run(data_dir: str, local_name: str, passphrase: str, compact: bool) -> int:
+    root = Path(data_dir)
+    core = await Core.open(
+        OpenOptions(
+            storage=FsStorage(str(root / local_name), str(root / "remote")),
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PassphraseKeyCryptor(passphrase),
+            adapter=mvreg_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        )
+    )
+    await core.read_remote()
+
+    seen = core.with_state(lambda s: s.read().values)
+    value = max((int(v) for v in seen), default=0) + 1
+    print(f"[{local_name}] saw {sorted(int(v) for v in seen)} -> writing {value}")
+
+    # derive the write op under the core's writer lock, then persist it
+    await core.update(lambda s: s.write_ctx(core.actor_id, value))
+
+    if compact:
+        await core.compact()
+        print(f"[{local_name}] compacted: op tail folded into one snapshot")
+    return value
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data", default="./data", help="root holding local dirs + shared remote")
+    ap.add_argument("--local", default="dev-a", help="this replica's local dir name")
+    ap.add_argument("--passphrase", default="example-passphrase")
+    ap.add_argument("--compact", action="store_true", help="compact after writing")
+    args = ap.parse_args()
+    asyncio.run(run(args.data, args.local, args.passphrase, args.compact))
+
+
+if __name__ == "__main__":
+    main()

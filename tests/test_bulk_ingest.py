@@ -23,7 +23,12 @@ from crdt_enc_tpu.backends.xchacha import (
     AeadError,
 )
 from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
-from crdt_enc_tpu.core.adapters import HostAccelerator, gcounter_adapter
+from crdt_enc_tpu.core.adapters import (
+    HostAccelerator,
+    gcounter_adapter,
+    mvreg_adapter,
+    pncounter_adapter,
+)
 from crdt_enc_tpu.models import ORSet, canonical_bytes
 from crdt_enc_tpu.parallel.accel import TpuAccelerator
 from crdt_enc_tpu.utils import codec
@@ -102,21 +107,62 @@ def test_bulk_ingest_non_columnar_adapter_falls_back(monkeypatch):
     async def go():
         remote = MemoryRemote()
         writer = await Core.open(
-            make_opts(MemoryStorage(remote), gcounter_adapter())
+            make_opts(MemoryStorage(remote), mvreg_adapter())
         )
         for i in range(20):
-            await writer.apply_ops(
-                [writer.with_state(lambda s: s.inc(writer.actor_id))]
+            await writer.update(
+                lambda s: s.write_ctx(writer.actor_id, i)
             )
         reader = await Core.open(
             make_opts(
                 MemoryStorage(remote),
-                gcounter_adapter(),
+                mvreg_adapter(),
                 accel=TpuAccelerator(min_device_batch=1),
             )
         )
         await reader.read_remote()
-        assert reader.with_state(lambda s: s.read()) == 20
+        assert reader.with_state(lambda s: s.read().values) == [19]
+
+    run(go())
+
+
+@pytest.mark.parametrize("kind", ["gcounter", "pncounter"])
+def test_bulk_ingest_counters_match_per_file(kind, monkeypatch):
+    """The native counter bulk path must equal the per-file reference."""
+
+    async def go():
+        adapter = gcounter_adapter if kind == "gcounter" else pncounter_adapter
+        remote = MemoryRemote()
+        writer = await Core.open(make_opts(MemoryStorage(remote), adapter()))
+        for i in range(30):
+            if kind == "pncounter" and i % 3 == 2:
+                await writer.apply_ops(
+                    [writer.with_state(lambda s: s.dec(writer.actor_id, i % 4 + 1))]
+                )
+            else:
+                await writer.apply_ops(
+                    [writer.with_state(lambda s: s.inc(writer.actor_id, i % 5 + 1))]
+                )
+
+        bulk = await Core.open(
+            make_opts(
+                MemoryStorage(remote),
+                adapter(),
+                accel=TpuAccelerator(min_device_batch=1),
+            )
+        )
+        await bulk.read_remote()
+
+        monkeypatch.setattr(core_mod, "BULK_MIN_FILES", 10**9)
+        ref = await Core.open(make_opts(MemoryStorage(remote), adapter()))
+        await ref.read_remote()
+
+        assert bulk.with_state(lambda s: s.read()) == ref.with_state(
+            lambda s: s.read()
+        )
+        assert canonical_bytes(bulk.with_state(lambda s: s)) == canonical_bytes(
+            ref.with_state(lambda s: s)
+        )
 
     run(go())
 
@@ -156,6 +202,26 @@ def test_decode_orset_payload_batch_matches_python():
     for i in range(len(kind)):
         assert members[member_idx[i]] == ref.members.items[ref.member[i]]
         assert actors[actor_idx[i]] == ref.replicas.items[ref.actor[i]]
+
+
+def test_fold_payloads_bails_on_member_value_collision():
+    """Distinct canonical encodings that collide as Python values (1 == True)
+    would collapse the member vocab and scatter rows out of range; the
+    accelerator must decline so the per-op host path (whose dict semantics
+    define the contract) handles the batch."""
+    from crdt_enc_tpu.models.vclock import Dot
+
+    actor = uuid.UUID(int=1).bytes
+    ops = [
+        [0, 1, Dot(actor, 1).to_obj()],
+        [0, True, Dot(actor, 2).to_obj()],
+        [0, b"x", Dot(actor, 3).to_obj()],
+    ]
+    payload = codec.pack(ops)
+    accel = TpuAccelerator(min_device_batch=1)
+    state = ORSet()
+    assert accel.fold_payloads(state, [payload], actors_hint=[actor]) is False
+    assert canonical_bytes(state) == canonical_bytes(ORSet())  # untouched
 
 
 def test_decode_unknown_actor_returns_none():

@@ -1,0 +1,32 @@
+"""The examples/ app as a smoke test — the reference uses its example the
+same way (SURVEY.md §4: example-as-smoke-test), but with assertions added."""
+
+import asyncio
+import importlib.util
+import sys
+from pathlib import Path
+
+EXAMPLE = Path(__file__).resolve().parent.parent / "examples" / "counter_sync.py"
+
+
+def load_example():
+    spec = importlib.util.spec_from_file_location("counter_sync", EXAMPLE)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["counter_sync"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_example_two_replicas_climb(tmp_path):
+    ex = load_example()
+
+    async def go():
+        v1 = await ex.run(str(tmp_path), "dev-a", "pw", compact=False)
+        # dev-b joins the same remote, must see dev-a's write and go one up
+        v2 = await ex.run(str(tmp_path), "dev-b", "pw", compact=True)
+        # dev-a runs again after dev-b's compaction: resumes from the snapshot
+        v3 = await ex.run(str(tmp_path), "dev-a", "pw", compact=False)
+        return v1, v2, v3
+
+    v1, v2, v3 = asyncio.run(go())
+    assert (v1, v2, v3) == (1, 2, 3)
