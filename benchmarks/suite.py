@@ -1,0 +1,448 @@
+"""The five BASELINE.json benchmark configs, host-reference vs device.
+
+Each config measures: single-core host-reference fold rate (the per-op
+loop the reference runs, capped to a subsample for the big configs — the
+loop is O(n) so per-op rate transfers), device fold rate (compile
+excluded, best of ITERS), and a byte-equality check of the folded state
+against the host reference on a common subsample.
+
+Run:  python benchmarks/suite.py [--smoke] [--config N] [--cpu]
+Prints one JSON line per config and a trailing summary line.
+
+Sizes are env-tunable (SUITE_SCALE=0.1 scales every N down 10x).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import uuid
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def running_count(group: np.ndarray, n_groups: int) -> np.ndarray:
+    """1-based running occurrence count per group id, in row order."""
+    n = len(group)
+    order = np.argsort(group, kind="stable")
+    g = group[order]
+    cum = np.arange(1, n + 1, dtype=np.int64)
+    starts = np.searchsorted(g, np.arange(n_groups))
+    base = starts[g]
+    within = cum - base
+    out = np.empty(n, np.int64)
+    out[order] = within
+    return out.astype(np.int32)
+
+
+def timeit(fn, iters: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn())  # compile + warmup
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def actor_bytes_table(R: int) -> list:
+    """R actor ids whose byte order equals their index order."""
+    return [uuid.UUID(int=i + 1).bytes for i in range(R)]
+
+
+# --------------------------------------------------------------- config 1+2
+
+
+def bench_gcounter(N: int, R: int, iters: int) -> dict:
+    """Config 1: G-Counter, 4 replicas, 1k increment ops."""
+    import jax
+
+    from crdt_enc_tpu import ops as K
+    from crdt_enc_tpu.models import GCounter
+    from crdt_enc_tpu.models.vclock import Dot
+
+    rng = np.random.default_rng(1)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = running_count(actor, R)
+    actors = actor_bytes_table(R)
+
+    state = GCounter()
+    t0 = time.perf_counter()
+    for a, c in zip(actor.tolist(), counter.tolist()):
+        state.apply(Dot(actors[a], c))
+    t_host = time.perf_counter() - t0
+
+    clock0 = np.zeros(R, np.int32)
+    dev_args = [jax.device_put(x) for x in (clock0, actor, counter)]
+    t_dev = timeit(
+        lambda: K.gcounter_fold(*dev_args, num_replicas=R), iters
+    )
+    clock, total = K.gcounter_fold(*dev_args, num_replicas=R)
+    dev_clock = {actors[i]: int(c) for i, c in enumerate(np.asarray(clock)) if c}
+    equal = dev_clock == state.clock.counters and int(total) == state.read()
+    return dict(
+        config="gcounter_4x1k", metric="ops_folded_per_sec", N=N, R=R,
+        host_rate=N / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+    )
+
+
+def bench_pncounter(N: int, R: int, iters: int) -> dict:
+    """Config 2: PN-Counter, 1k replicas, 100k mixed inc/dec ops."""
+    import jax
+
+    from crdt_enc_tpu import ops as K
+    from crdt_enc_tpu.models import PNCounter
+    from crdt_enc_tpu.models.counters import NEG, POS
+    from crdt_enc_tpu.models.vclock import Dot
+
+    rng = np.random.default_rng(2)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    sign = (rng.random(N) < 0.3).astype(np.int8)  # ~30% decrements
+    counter = running_count(actor * 2 + sign, R * 2)
+    actors = actor_bytes_table(R)
+
+    n_host = min(N, 200_000)
+    state = PNCounter()
+    t0 = time.perf_counter()
+    for a, s, c in zip(
+        actor[:n_host].tolist(), sign[:n_host].tolist(), counter[:n_host].tolist()
+    ):
+        state.apply((int(s), Dot(actors[a], c)))
+    t_host = time.perf_counter() - t0
+
+    p0 = np.zeros(R, np.int32)
+    n0 = np.zeros(R, np.int32)
+    dev_args = [jax.device_put(x) for x in (p0, n0, sign, actor, counter)]
+    t_dev = timeit(
+        lambda: K.pncounter_fold(*dev_args, num_replicas=R), iters
+    )
+    # byte equality on the host subsample
+    ps, ns, val = K.pncounter_fold(
+        p0, n0, sign[:n_host], actor[:n_host], counter[:n_host], num_replicas=R
+    )
+    dev_p = {actors[i]: int(c) for i, c in enumerate(np.asarray(ps)) if c}
+    dev_n = {actors[i]: int(c) for i, c in enumerate(np.asarray(ns)) if c}
+    equal = (
+        dev_p == state.p.clock.counters
+        and dev_n == state.n.clock.counters
+        and int(val) == state.read()
+    )
+    return dict(
+        config="pncounter_1kx100k", metric="ops_folded_per_sec", N=N, R=R,
+        host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+    )
+
+
+# ----------------------------------------------------------------- config 3
+
+
+def bench_orset(N: int, R: int, E: int, n_host: int, iters: int) -> dict:
+    """Config 3 (north star): OR-Set, 10k replicas, 1M add/remove ops."""
+    import jax
+
+    import bench as north
+
+    from crdt_enc_tpu import ops as K
+    from crdt_enc_tpu.ops.columnar import Vocab, orset_planes_to_state
+    from crdt_enc_tpu.utils import codec
+
+    kind, member, actor, counter = north.gen_columns(N, R, E)
+
+    n_chk = min(N, 20_000)
+    h_state, _ = north.host_fold(
+        kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk], R
+    )
+    c0 = np.zeros(R, np.int32)
+    a0 = np.zeros((E, R), np.int32)
+    r0 = np.zeros((E, R), np.int32)
+    ck, ad, rm = K.orset_fold(
+        c0, a0, r0, kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk],
+        num_members=E, num_replicas=R,
+    )
+    t_state = orset_planes_to_state(
+        np.asarray(ck), np.asarray(ad), np.asarray(rm), Vocab(range(E)), Vocab(range(R))
+    )
+    equal = codec.pack(t_state.to_obj()) == codec.pack(h_state.to_obj())
+
+    _, t_host = north.host_fold(
+        kind[:n_host], member[:n_host], actor[:n_host], counter[:n_host], R
+    )
+    args = [jax.device_put(x) for x in (c0, a0, r0, kind, member, actor, counter)]
+    t_dev = timeit(
+        lambda: K.orset_fold(*args, num_members=E, num_replicas=R), iters
+    )
+    return dict(
+        config="orset_10kx1M", metric="ops_folded_per_sec", N=N, R=R, E=E,
+        host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+    )
+
+
+# ----------------------------------------------------------------- config 4
+
+
+def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int) -> dict:
+    """Config 4: LWW-map, 1M keys, 10k replicas, timestamped writes."""
+    import jax
+
+    from crdt_enc_tpu import ops as K
+    from crdt_enc_tpu.models import LWWMap
+    from crdt_enc_tpu.models.lwwmap import LWWOp
+    from crdt_enc_tpu.ops.lww import ts_split
+
+    rng = np.random.default_rng(4)
+    key = rng.integers(0, K_keys, N, dtype=np.int32)
+    ts = rng.integers(1, 1 << 40, N, dtype=np.int64)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    # single-byte msgpack domain so value rank == numeric value
+    value = rng.integers(0, 100, N, dtype=np.int32)
+    hi, lo = ts_split(ts)
+    actors = actor_bytes_table(R)
+
+    state = LWWMap()
+    t0 = time.perf_counter()
+    for k, t, a, v in zip(
+        key[:n_host].tolist(), ts[:n_host].tolist(),
+        actor[:n_host].tolist(), value[:n_host].tolist(),
+    ):
+        state.apply(LWWOp(k, t, actors[a], v))
+    t_host = time.perf_counter() - t0
+
+    args = [jax.device_put(x) for x in (key, hi, lo, actor, value)]
+    t_dev = timeit(lambda: K.lww_fold(*args, num_keys=K_keys), iters)
+
+    # byte equality on the host subsample
+    m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
+        key[:n_host], hi[:n_host], lo[:n_host], actor[:n_host], value[:n_host],
+        num_keys=K_keys,
+    )
+    m_hi, m_lo = np.asarray(m_hi), np.asarray(m_lo)
+    m_actor, m_value = np.asarray(m_actor), np.asarray(m_value)
+    idx = np.flatnonzero(np.asarray(present))
+    dev_map = LWWMap()
+    dev_map.entries = {
+        int(k): [
+            (int(m_hi[k]) << 31) | int(m_lo[k]),
+            actors[int(m_actor[k])],
+            int(m_value[k]),
+            False,
+        ]
+        for k in idx
+    }
+    equal = dev_map == state
+    return dict(
+        config="lwwmap_1Mx10k", metric="writes_folded_per_sec", N=N,
+        K=K_keys, R=R,
+        host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+    )
+
+
+# ----------------------------------------------------------------- config 5
+
+
+def _build_encrypted_files(N, R, E, ops_per_file, key, n_headers):
+    """Columns → per-(actor)-ordered op files, sealed with the native AEAD,
+    plus a few header-CRDT (Keys-style MVReg) blobs mixed in."""
+    import bench as north
+
+    from crdt_enc_tpu.backends.xchacha import encrypt_blob
+    from crdt_enc_tpu.models import MVReg
+    from crdt_enc_tpu.utils import codec
+
+    kind, member, actor, counter = north.gen_columns(N, R, E, seed=5)
+    actors = actor_bytes_table(R)
+    live = actor < R
+    order = np.argsort(actor[live], kind="stable")
+    k_l = kind[live][order]
+    m_l = member[live][order]
+    a_l = actor[live][order]
+    c_l = counter[live][order]
+
+    payloads, plain_payloads = [], []
+    i, n = 0, len(k_l)
+    while i < n:
+        j = min(i + ops_per_file, n)
+        # keep a file within one actor (files are per (actor, version))
+        j = i + int(np.searchsorted(a_l[i:j], a_l[i], side="right"))
+        ops = []
+        for t in range(i, j):
+            ab = actors[int(a_l[t])]
+            if k_l[t] == 0:
+                ops.append([0, int(m_l[t]), [ab, int(c_l[t])]])
+            else:
+                ops.append([1, int(m_l[t]), {ab: int(c_l[t])}])
+        raw = codec.pack(ops)
+        plain_payloads.append(raw)
+        payloads.append(encrypt_blob(key, raw))
+        i = j
+
+    headers = []
+    for h in range(n_headers):
+        reg = MVReg()
+        reg.apply(reg.write_ctx(actors[h % R], [b"hdr", h]))
+        headers.append(encrypt_blob(key, codec.pack(reg.to_obj())))
+    return payloads, plain_payloads, headers, actors
+
+
+def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
+    """Config 5: mixed header-CRDT + OR-Set, 100k replicas, streaming
+    compaction with the XChaCha20-Poly1305 decrypt front end."""
+    import secrets
+
+    import jax
+
+    from crdt_enc_tpu import ops as K
+    from crdt_enc_tpu.backends.xchacha import decrypt_blob, decrypt_blobs
+    from crdt_enc_tpu.models import MVReg, ORSet
+    from crdt_enc_tpu.models.orset import AddOp, RmOp
+    from crdt_enc_tpu.models.vclock import Dot, VClock
+    from crdt_enc_tpu.ops.columnar import Vocab, orset_planes_to_state
+    from crdt_enc_tpu.ops.native_decode import decode_orset_payload_batch
+    from crdt_enc_tpu.utils import codec
+
+    key = secrets.token_bytes(32)
+    payloads, plain, headers, actors = _build_encrypted_files(
+        N, R, E, ops_per_file, key, n_headers=max(1, len(str(N)))
+    )
+    n_files = len(payloads)
+    n_ops = sum(len(codec.unpack(p)) for p in plain[:n_host_files])
+    log(f"  streaming: {n_files} files, {len(headers)} headers")
+
+    # ---- single-core host baseline: sequential decrypt → decode → apply
+    state = ORSet()
+    t0 = time.perf_counter()
+    for blob in payloads[:n_host_files]:
+        raw = decrypt_blob(key, blob)
+        for o in codec.unpack(raw):
+            if o[0] == 0:
+                state.apply(AddOp(o[1], Dot.from_obj(o[2])))
+            else:
+                state.apply(RmOp(o[1], VClock.from_obj(o[2])))
+    for h in headers:
+        MVReg.from_obj(codec.unpack(decrypt_blob(key, h)))
+    t_host = time.perf_counter() - t0
+    host_rate = n_ops / t_host
+
+    # ---- streaming pipeline: threaded batch decrypt → native columnar
+    # decode → device fold (headers decoded host-side, they are tiny)
+    actors_sorted = sorted(actors)
+    c0 = np.zeros(R, np.int32)
+    a0 = np.zeros((E, R), np.int32)
+    r0 = np.zeros((E, R), np.int32)
+
+    def pipeline():
+        clears = decrypt_blobs(key, payloads)
+        for h in decrypt_blobs(key, headers):
+            MVReg.from_obj(codec.unpack(h))
+        decoded = decode_orset_payload_batch(clears, actors_sorted)
+        kind, member_idx, actor_idx, counter, member_objs = decoded
+        return K.orset_fold(
+            c0, a0, r0, kind, member_idx, actor_idx, counter,
+            num_members=E, num_replicas=R,
+        )
+
+    total_ops = sum(len(codec.unpack(p)) for p in plain)
+    t_dev = timeit(pipeline, iters)
+    dev_rate = total_ops / t_dev
+
+    # ---- byte equality: full host fold over the same subsample files
+    clears = decrypt_blobs(key, payloads[:n_host_files])
+    kind, member_idx, actor_idx, counter, member_objs = decode_orset_payload_batch(
+        clears, actors_sorted
+    )
+    members = Vocab(member_objs)
+    replicas = Vocab(actors_sorted)
+    ck, ad, rm = K.orset_fold(
+        c0, a0, r0, kind, member_idx, actor_idx, counter,
+        num_members=E, num_replicas=R,
+    )
+    # decode planes through the decoder's member interning: plane row i is
+    # members.items[i] for i < len(member_objs); rows beyond are untouched 0
+    dev_state = orset_planes_to_state(
+        np.asarray(ck), np.asarray(ad), np.asarray(rm),
+        Vocab(member_objs + [("pad", i) for i in range(E - len(member_objs))]),
+        replicas,
+    )
+    equal = codec.pack(dev_state.to_obj()) == codec.pack(state.to_obj())
+    return dict(
+        config="mixed_streaming_100k", metric="ops_streamed_per_sec",
+        N=total_ops, R=R, E=E, files=n_files,
+        host_rate=host_rate, device_rate=dev_rate, byte_equal=bool(equal),
+    )
+
+
+# --------------------------------------------------------------------- main
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--config", type=int, default=0, help="run one config (1-5)")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument(
+        "--cpu", action="store_true",
+        help="force the CPU backend (the env's sitecustomize registers the "
+        "TPU plugin eagerly, so JAX_PLATFORMS=cpu alone is not enough)",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+    scale = float(os.environ.get("SUITE_SCALE", 0.02 if args.smoke else 1.0))
+
+    def S(n, lo=64):
+        return max(lo, int(n * scale))
+
+    runners = {
+        1: lambda: bench_gcounter(S(1_000), 4, args.iters),
+        2: lambda: bench_pncounter(S(100_000), min(1_000, S(1_000)), args.iters),
+        3: lambda: bench_orset(
+            S(1_000_000), min(10_000, S(10_000)), min(4096, S(4096)),
+            n_host=S(100_000, lo=2_000), iters=args.iters,
+        ),
+        4: lambda: bench_lwwmap(
+            S(1_000_000), min(1_000_000, S(1_000_000)), min(10_000, S(10_000)),
+            n_host=S(50_000, lo=2_000), iters=args.iters,
+        ),
+        5: lambda: bench_streaming(
+            S(200_000), min(100_000, S(100_000)), min(1024, S(1024)),
+            ops_per_file=48, n_host_files=S(300, lo=20), iters=args.iters,
+        ),
+    }
+    wanted = [args.config] if args.config else sorted(runners)
+    results = []
+    for c in wanted:
+        log(f"config {c}…")
+        r = runners[c]()
+        r["vs_baseline"] = round(r["device_rate"] / r["host_rate"], 2)
+        r["host_rate"] = round(r["host_rate"], 1)
+        r["device_rate"] = round(r["device_rate"], 1)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    ok = all(r["byte_equal"] for r in results)
+    print(json.dumps({
+        "suite": "baseline_configs", "device": str(dev.device_kind),
+        "configs_run": wanted, "all_byte_equal": ok,
+        "geomean_speedup": round(
+            float(np.exp(np.mean([np.log(r["vs_baseline"]) for r in results]))), 2
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

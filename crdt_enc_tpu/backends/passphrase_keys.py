@@ -71,19 +71,19 @@ def _derive(passphrase: bytes, salt: bytes, log2_n: int, r: int, p: int) -> byte
 
 
 def wrap_blob(passphrase: bytes, raw: bytes, *, log2_n: int = KDF_LOG2_N,
-              r: int = KDF_R, p: int = KDF_P) -> bytes:
+              r: int = KDF_R, p: int = KDF_P, derive=_derive) -> bytes:
     if not _params_in_bounds(log2_n, r, p):
         raise ValueError(
             f"KDF params out of bounds (log2_n={log2_n}, r={r}, p={p}); "
             f"max log2_n={MAX_LOG2_N}, r={MAX_R}, p={MAX_P}"
         )
     salt = secrets.token_bytes(SALT_LEN)
-    key = _derive(passphrase, salt, log2_n, r, p)
+    key = derive(passphrase, salt, log2_n, r, p)
     sealed = xchacha.encrypt_blob(key, raw)
     return codec.pack([salt, log2_n, r, p, sealed])
 
 
-def unwrap_blob(passphrase: bytes, blob: bytes) -> bytes:
+def unwrap_blob(passphrase: bytes, blob: bytes, *, derive=_derive) -> bytes:
     try:
         salt, log2_n, r, p, sealed = codec.unpack(blob)
         # type-check, never coerce: bytes(attacker_int) would zero-allocate
@@ -101,7 +101,7 @@ def unwrap_blob(passphrase: bytes, blob: bytes) -> bytes:
             f"KDF params out of bounds (log2_n={log2_n}, r={r}, p={p})"
         )
     try:
-        key = _derive(passphrase, salt, log2_n, r, p)
+        key = derive(passphrase, salt, log2_n, r, p)
     except ValueError as e:  # hostile blob must never escape the error contract
         raise WrongPassphrase(f"KDF failed: {e}") from e
     try:
@@ -128,12 +128,30 @@ class PassphraseKeyCryptor(PlainKeyCryptor):
             )
         self._passphrase = passphrase
         self._kdf = (kdf_log2_n, kdf_r, kdf_p)
+        # (salt, log2_n, r, p) -> derived key: set_keys unwraps the blob it
+        # just wrapped, and every meta notification re-unwraps unchanged
+        # blobs — the cache makes repeat derivations free without touching
+        # the fresh-salt-per-write property
+        self._kdf_cache: dict = {}
+
+    def _derive_cached(self, passphrase, salt, log2_n, r, p):
+        ck = (salt, log2_n, r, p)
+        key = self._kdf_cache.get(ck)
+        if key is None:
+            key = _derive(passphrase, salt, log2_n, r, p)
+            if len(self._kdf_cache) >= 64:  # hostile metas can't flood it
+                self._kdf_cache.pop(next(iter(self._kdf_cache)))
+            self._kdf_cache[ck] = key
+        return key
 
     async def _protect(self, raw: bytes) -> bytes:
         log2_n, r, p = self._kdf
         return await asyncio.to_thread(
-            wrap_blob, self._passphrase, raw, log2_n=log2_n, r=r, p=p
+            wrap_blob, self._passphrase, raw,
+            log2_n=log2_n, r=r, p=p, derive=self._derive_cached,
         )
 
     async def _unprotect(self, vb) -> bytes:
-        return await asyncio.to_thread(unwrap_blob, self._passphrase, vb.content)
+        return await asyncio.to_thread(
+            unwrap_blob, self._passphrase, vb.content, derive=self._derive_cached
+        )

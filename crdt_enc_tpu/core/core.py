@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 
 from ..models import MVReg, VClock
 from ..models.vclock import Actor, Dot
-from ..utils import VersionBytes, codec
+from ..utils import VersionBytes, codec, trace
 from ..utils.versions import (
     CURRENT_CONTAINER_VERSION,
     SUPPORTED_CONTAINER_VERSIONS,
@@ -354,11 +354,13 @@ class Core:
         await self._read_remote_ops()
 
     async def _read_remote_states(self) -> None:
-        names = await self.storage.list_state_names()
+        with trace.span("states.list"):
+            names = await self.storage.list_state_names()
         new = [n for n in names if n not in self._data.read_states]
         if not new:
             return
-        loaded = await self.storage.load_states(new)
+        with trace.span("states.load"):
+            loaded = await self.storage.load_states(new)
         sem = asyncio.Semaphore(IO_CONCURRENCY)
 
         async def decode(name: str, raw: bytes):
@@ -368,20 +370,28 @@ class Core:
                     self.adapter.state_from_obj(obj[0]), VClock.from_obj(obj[1])
                 )
 
-        decoded = await asyncio.gather(*(decode(n, raw) for n, raw in loaded))
+        with trace.span("states.decrypt_decode"):
+            decoded = await asyncio.gather(*(decode(n, raw) for n, raw in loaded))
         # sync section: CvRDT merge (HOT LOOP #1 → accelerator)
         wrappers = [sw for _, sw in decoded]
-        self.accel.merge_states(self._data.state, [sw.state for sw in wrappers])
+        with trace.span("states.merge"):
+            self.accel.merge_states(
+                self._data.state, [sw.state for sw in wrappers]
+            )
+        trace.add("states_merged", len(wrappers))
         for _, sw in decoded:
             self._data.next_op_versions.merge(sw.next_op_versions)
         self._data.read_states.update(name for name, _ in decoded)
 
     async def _read_remote_ops(self) -> None:
-        actors = await self.storage.list_op_actors()
+        with trace.span("ops.list"):
+            actors = await self.storage.list_op_actors()
         wanted = [
             (a, self._data.next_op_versions.get(a) + 1) for a in sorted(actors)
         ]
-        files = await self.storage.load_ops(wanted)
+        with trace.span("ops.load"):
+            files = await self.storage.load_ops(wanted)
+        trace.add("op_files_loaded", len(files))
         if not files:
             return
         if len(files) >= BULK_MIN_FILES:
@@ -397,7 +407,10 @@ class Core:
 
         # concurrent decode, ORDER PRESERVED (the reference's `buffered`
         # not `buffer_unordered` — ordering is load-bearing, lib.rs:497-514)
-        decoded = await asyncio.gather(*(decode(a, v, raw) for a, v, raw in files))
+        with trace.span("ops.decrypt_decode"):
+            decoded = await asyncio.gather(
+                *(decode(a, v, raw) for a, v, raw in files)
+            )
 
         # sync section: version bookkeeping + batched fold (HOT LOOP #2)
         batch = []
@@ -413,7 +426,9 @@ class Core:
             batch.extend(self.adapter.op_from_obj(o) for o in payload)
             self._data.next_op_versions.apply(Dot(actor, version))
         if batch:
-            self.accel.fold_ops(self._data.state, batch)
+            with trace.span("ops.fold"):
+                self.accel.fold_ops(self._data.state, batch)
+            trace.add("ops_folded", len(batch))
 
     async def _read_remote_ops_bulk(self, files: list, actors) -> bool:
         """Bulk ingestion: unwrap all outer envelopes, one batched decrypt
@@ -423,32 +438,35 @@ class Core:
         precise error; key-auth and op-order violations raise exactly as
         the per-file path would (lib.rs:519-531 semantics preserved)."""
         try:
-            key_ids, middles = [], []
-            for _, _, raw in files:
-                outer = VersionBytes.deserialize(raw).ensure_versions(
-                    SUPPORTED_CONTAINER_VERSIONS
-                )
-                kid, middle = codec.unpack(outer.content)
-                key_ids.append(bytes(kid))
-                middles.append(bytes(middle))
+            with trace.span("ops.bulk_unwrap"):
+                key_ids, middles = [], []
+                for _, _, raw in files:
+                    outer = VersionBytes.deserialize(raw).ensure_versions(
+                        SUPPORTED_CONTAINER_VERSIONS
+                    )
+                    kid, middle = codec.unpack(outer.content)
+                    key_ids.append(bytes(kid))
+                    middles.append(bytes(middle))
         except Exception:
             return False
         groups: dict[bytes, list[int]] = {}
         for i, kid in enumerate(key_ids):
             groups.setdefault(kid, []).append(i)
         clears: list = [None] * len(files)
-        for kid, idxs in groups.items():
-            key = self._data.keys.get_key(kid)
-            if key is None:
-                raise MissingKeyError(
-                    f"ops sealed with unknown key {uuid.UUID(bytes=kid)}; "
-                    "key metadata may not have synced yet"
+        with trace.span("ops.bulk_decrypt"):
+            for kid, idxs in groups.items():
+                key = self._data.keys.get_key(kid)
+                if key is None:
+                    raise MissingKeyError(
+                        f"ops sealed with unknown key {uuid.UUID(bytes=kid)}; "
+                        "key metadata may not have synced yet"
+                    )
+                outs = await self.cryptor.decrypt_batch(
+                    key.material, [middles[i] for i in idxs]
                 )
-            outs = await self.cryptor.decrypt_batch(
-                key.material, [middles[i] for i in idxs]
-            )
-            for i, clear in zip(idxs, outs):
-                clears[i] = clear
+                for i, clear in zip(idxs, outs):
+                    clears[i] = clear
+        trace.add("bytes_decrypted", sum(len(m) for m in middles))
 
         # sync section: inner version checks + ordered bookkeeping + fold
         payloads = []
@@ -468,16 +486,21 @@ class Core:
             self._data.next_op_versions.apply(Dot(actor, version))
         if not payloads:
             return True
-        if self.accel.fold_payloads(
-            self._data.state, payloads, actors_hint=actors
-        ):
-            return True
-        # accelerator declined (non-columnar CRDT): decode per-op in Python
-        # but still fold as one batch
-        batch = []
-        for p in payloads:
-            batch.extend(self.adapter.op_from_obj(o) for o in codec.unpack(p))
-        self.accel.fold_ops(self._data.state, batch)
+        with trace.span("ops.bulk_fold"):
+            if self.accel.fold_payloads(
+                self._data.state, payloads, actors_hint=actors
+            ):
+                trace.add("op_files_bulk_folded", len(payloads))
+                return True
+            # accelerator declined (non-columnar CRDT): decode per-op in
+            # Python but still fold as one batch
+            batch = []
+            for p in payloads:
+                batch.extend(
+                    self.adapter.op_from_obj(o) for o in codec.unpack(p)
+                )
+            self.accel.fold_ops(self._data.state, batch)
+            trace.add("ops_folded", len(batch))
         return True
 
     # --------------------------------------------------------------- compact
@@ -493,13 +516,18 @@ class Core:
         ]
         states_to_remove = sorted(d.read_states)
         ops_to_remove = sorted(d.next_op_versions.counters.items())
-        blob = await self._seal(payload)
+        with trace.span("compact.seal"):
+            blob = await self._seal(payload)
         # crash safety: the new snapshot is durable before anything vanishes
-        name = await self.storage.store_state(blob)
-        await asyncio.gather(
-            self.storage.remove_states([n for n in states_to_remove if n != name]),
-            self.storage.remove_ops(ops_to_remove),
-        )
+        with trace.span("compact.write"):
+            name = await self.storage.store_state(blob)
+        with trace.span("compact.gc"):
+            await asyncio.gather(
+                self.storage.remove_states(
+                    [n for n in states_to_remove if n != name]
+                ),
+                self.storage.remove_ops(ops_to_remove),
+            )
         # sync bookkeeping section
         d.read_states.difference_update(states_to_remove)
         d.read_states.add(name)

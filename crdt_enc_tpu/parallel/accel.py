@@ -150,29 +150,11 @@ class TpuAccelerator(HostAccelerator):
         sign, actor_idx, counter = decoded
         if len(sign) == 0:
             return True
-        replicas = K.Vocab(actors_sorted)
-        R = len(replicas)
-        n = len(sign)
-        cols = self._pad_counter_cols(
-            K.CounterColumns(sign, actor_idx, counter, replicas), R
+        if isinstance(state, GCounter) and np.any(sign != POS):
+            return False  # PN-shaped rows in a G-Counter state
+        self._fold_counter_dense(
+            state, K.CounterColumns(sign, actor_idx, counter, K.Vocab(actors_sorted))
         )
-        sign, actor_idx, counter = cols.sign, cols.actor, cols.counter
-        if isinstance(state, PNCounter):
-            p0 = K.vclock_to_dense(state.p.clock, replicas)
-            n0 = K.vclock_to_dense(state.n.clock, replicas)
-            p, nn, _ = K.pncounter_fold(
-                p0, n0, sign, actor_idx, counter, num_replicas=R
-            )
-            state.p.clock = K.dense_to_vclock(np.asarray(p), replicas)
-            state.n.clock = K.dense_to_vclock(np.asarray(nn), replicas)
-        else:
-            if np.any(sign[:n] != POS):  # PN-shaped rows in a G-Counter state
-                return False
-            clock0 = K.vclock_to_dense(state.clock, replicas)
-            clock, _ = K.gcounter_fold(
-                clock0, actor_idx, counter, num_replicas=R
-            )
-            state.clock = K.dense_to_vclock(np.asarray(clock), replicas)
         return True
 
     @staticmethod
@@ -187,35 +169,44 @@ class TpuAccelerator(HostAccelerator):
             cols.counter = np.concatenate([cols.counter, np.zeros(padn, np.int32)])
         return cols
 
-    def _fold_gcounter(self, state: GCounter, ops: list) -> GCounter:
-        replicas = K.Vocab()
-        cols = K.counter_ops_to_columns(ops, replicas)
-        clock0 = K.vclock_to_dense(state.clock, replicas)
-        R = len(replicas)
-        self._pad_counter_cols(cols, R)
-        clock, _ = K.gcounter_fold(
-            clock0, cols.actor, cols.counter, num_replicas=R
+    def _fold_counter_dense(self, state, cols):
+        """Shared tail for every counter fold: fix the replica vocab (state
+        actors included), pad the columns, run the kernel, write the dense
+        clocks back to the sparse state."""
+        replicas = cols.replicas
+        clocks = (
+            (state.p.clock, state.n.clock)
+            if isinstance(state, PNCounter)
+            else (state.clock,)
         )
-        state.clock = K.dense_to_vclock(np.asarray(clock), replicas)
+        for c in clocks:
+            for a in c.counters:
+                replicas.intern(a)
+        R = len(replicas)
+        if R == 0:
+            return state
+        self._pad_counter_cols(cols, R)
+        if isinstance(state, PNCounter):
+            p0 = K.vclock_to_dense(state.p.clock, replicas)
+            n0 = K.vclock_to_dense(state.n.clock, replicas)
+            p, n, _ = K.pncounter_fold(
+                p0, n0, cols.sign, cols.actor, cols.counter, num_replicas=R
+            )
+            state.p.clock = K.dense_to_vclock(np.asarray(p), replicas)
+            state.n.clock = K.dense_to_vclock(np.asarray(n), replicas)
+        else:
+            clock0 = K.vclock_to_dense(state.clock, replicas)
+            clock, _ = K.gcounter_fold(
+                clock0, cols.actor, cols.counter, num_replicas=R
+            )
+            state.clock = K.dense_to_vclock(np.asarray(clock), replicas)
         return state
 
+    def _fold_gcounter(self, state: GCounter, ops: list) -> GCounter:
+        return self._fold_counter_dense(state, K.counter_ops_to_columns(ops))
+
     def _fold_pncounter(self, state: PNCounter, ops: list) -> PNCounter:
-        replicas = K.Vocab()
-        cols = K.counter_ops_to_columns(ops, replicas)
-        p0 = K.vclock_to_dense(state.p.clock, replicas)
-        n0 = K.vclock_to_dense(state.n.clock, replicas)
-        R = len(replicas)
-        if len(p0) < R:
-            p0 = np.pad(p0, (0, R - len(p0)))
-        if len(n0) < R:
-            n0 = np.pad(n0, (0, R - len(n0)))
-        self._pad_counter_cols(cols, R)
-        p, n, _ = K.pncounter_fold(
-            p0, n0, cols.sign, cols.actor, cols.counter, num_replicas=R
-        )
-        state.p.clock = K.dense_to_vclock(np.asarray(p), replicas)
-        state.n.clock = K.dense_to_vclock(np.asarray(n), replicas)
-        return state
+        return self._fold_counter_dense(state, K.counter_ops_to_columns(ops))
 
     def _fold_lww(self, state: LWWMap, ops: list) -> LWWMap:
         cols = K.lww_ops_to_columns(ops)

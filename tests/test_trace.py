@@ -1,0 +1,73 @@
+"""Per-phase tracing: the observability layer the reference lacks
+(SURVEY.md §5 requires phase timers for list/load/decrypt/decode/fold/write
+and ops-merged counters in the rebuild)."""
+
+import asyncio
+
+from crdt_enc_tpu.backends import IdentityCryptor, MemoryRemote, MemoryStorage, PlainKeyCryptor
+from crdt_enc_tpu.core import Core, OpenOptions, gcounter_adapter
+from crdt_enc_tpu.utils import trace
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+def make_opts(remote):
+    return OpenOptions(
+        storage=MemoryStorage(remote),
+        cryptor=IdentityCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=gcounter_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=True,
+    )
+
+
+def test_span_and_counter_accumulate():
+    trace.reset()
+    with trace.span("phase.x"):
+        pass
+    with trace.span("phase.x"):
+        pass
+    trace.add("items", 3)
+    trace.add("items", 4)
+    snap = trace.snapshot()
+    assert snap["spans"]["phase.x"]["count"] == 2
+    assert snap["spans"]["phase.x"]["seconds"] >= 0
+    assert snap["counters"]["items"] == 7
+    assert "phase.x" in trace.report()
+    trace.reset()
+    assert trace.snapshot() == {"spans": {}, "counters": {}}
+
+
+def test_span_records_on_exception():
+    trace.reset()
+    try:
+        with trace.span("phase.err"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert trace.snapshot()["spans"]["phase.err"]["count"] == 1
+
+
+def test_lifecycle_emits_phase_spans():
+    trace.reset()
+
+    async def go():
+        remote = MemoryRemote()
+        w = await Core.open(make_opts(remote))
+        for _ in range(3):
+            await w.apply_ops([w.with_state(lambda s: s.inc(w.actor_id))])
+        r = await Core.open(make_opts(remote))
+        await r.read_remote()
+        await r.compact()
+
+    asyncio.run(go())
+    snap = trace.snapshot()
+    for name in ("ops.list", "ops.load", "ops.decrypt_decode", "ops.fold",
+                 "compact.seal", "compact.write", "compact.gc"):
+        assert name in snap["spans"], name
+    assert snap["counters"]["ops_folded"] == 3
+    assert snap["counters"]["op_files_loaded"] >= 3
+    tp = trace.throughput("ops.fold", "ops_folded")
+    assert tp is None or tp > 0
+    trace.reset()
