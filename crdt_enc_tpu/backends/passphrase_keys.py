@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import secrets
+import threading
 
 from ..utils import codec
 from ..utils.versions import (
@@ -133,15 +134,20 @@ class PassphraseKeyCryptor(PlainKeyCryptor):
         # blobs — the cache makes repeat derivations free without touching
         # the fresh-salt-per-write property
         self._kdf_cache: dict = {}
+        self._kdf_cache_lock = threading.Lock()
 
     def _derive_cached(self, passphrase, salt, log2_n, r, p):
         ck = (salt, log2_n, r, p)
-        key = self._kdf_cache.get(ck)
+        with self._kdf_cache_lock:
+            key = self._kdf_cache.get(ck)
         if key is None:
             key = _derive(passphrase, salt, log2_n, r, p)
-            if len(self._kdf_cache) >= 64:  # hostile metas can't flood it
-                self._kdf_cache.pop(next(iter(self._kdf_cache)))
-            self._kdf_cache[ck] = key
+            # concurrent to_thread workers share the cache; the lock keeps
+            # the evict-then-insert pair atomic (a double-pop would raise)
+            with self._kdf_cache_lock:
+                if len(self._kdf_cache) >= 64:  # hostile metas can't flood it
+                    self._kdf_cache.pop(next(iter(self._kdf_cache)), None)
+                self._kdf_cache[ck] = key
         return key
 
     async def _protect(self, raw: bytes) -> bytes:

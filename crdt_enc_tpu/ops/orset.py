@@ -111,14 +111,26 @@ def _merge_halves(c1, a1, r1, c2, a2, r2):
     return jax.vmap(orset_merge)(c1, a1, r1, c2, a2, r2)
 
 
-def orset_merge_many(clocks: jax.Array, adds: jax.Array, rms: jax.Array):
+def orset_merge_many(
+    clocks: jax.Array, adds: jax.Array, rms: jax.Array, impl: str | None = None
+):
     """Merge a stacked batch of S states ``(S,R) / (S,E,R)`` into one.
 
-    A tree reduction: S partial states (from S devices or S snapshot files)
-    collapse in ⌈log2 S⌉ rounds of the pairwise merge.  Merge associativity
-    (tests/test_crdt_laws.py) is what makes the tree order legal.
+    ``impl``: ``"tree"`` = ⌈log2 S⌉ rounds of the pairwise merge (XLA);
+    ``"pallas"`` = single-HBM-pass streaming kernel (ops/pallas_merge.py);
+    None = pallas on TPU for batches worth streaming, tree elsewhere.
+    Merge associativity (tests/test_crdt_laws.py) makes any order legal.
     """
     c, a, r = jnp.asarray(clocks), jnp.asarray(adds), jnp.asarray(rms)
+    if impl is None:
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "pallas" if on_tpu and c.shape[0] >= 4 else "tree"
+    if impl == "pallas":
+        from .pallas_merge import orset_merge_many_pallas
+
+        return orset_merge_many_pallas(
+            c, a, r, interpret=jax.default_backend() != "tpu"
+        )
     while c.shape[0] > 1:
         s = c.shape[0]
         half = s // 2

@@ -1,0 +1,84 @@
+"""The streaming Pallas S-way merge must equal the XLA tree reduction
+(and both equal the host CvRDT merge).  On CPU the kernel runs in
+interpreter mode — semantics only; the bandwidth win is a TPU property."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from crdt_enc_tpu import ops as K
+from crdt_enc_tpu.models import ORSet, canonical_bytes
+from crdt_enc_tpu.ops.pallas_merge import orset_merge_many_pallas
+
+from test_ops_kernels import fixed_vocabs, orset_script, run_script
+
+
+def stacked_planes(states):
+    members, replicas = fixed_vocabs()
+    planes = [K.orset_state_to_planes(s, members, replicas) for s in states]
+    return (
+        np.stack([p[0] for p in planes]),
+        np.stack([p[1] for p in planes]),
+        np.stack([p[2] for p in planes]),
+        members,
+        replicas,
+    )
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(orset_script, min_size=1, max_size=6))
+def test_pallas_merge_matches_tree_and_host(scripts):
+    states = [run_script(s)[0] for s in scripts]
+    host = ORSet()
+    for s in states:
+        host.merge(s)
+
+    clocks, adds, rms, members, replicas = stacked_planes(states)
+    ct, at_, rt = K.orset_merge_many(clocks, adds, rms, impl="tree")
+    cp, ap, rp = orset_merge_many_pallas(clocks, adds, rms, interpret=True)
+
+    np.testing.assert_array_equal(np.asarray(ct), np.asarray(cp))
+    np.testing.assert_array_equal(np.asarray(at_), np.asarray(ap))
+    np.testing.assert_array_equal(np.asarray(rt), np.asarray(rp))
+
+    device = K.orset_planes_to_state(
+        np.asarray(cp), np.asarray(ap), np.asarray(rp), members, replicas
+    )
+    assert canonical_bytes(device) == canonical_bytes(host)
+
+
+def test_pallas_merge_unaligned_shapes():
+    """E and R far from the (8, 128) tile: padding must be invisible."""
+    rng = np.random.default_rng(9)
+    S, E, R = 5, 13, 37
+    clocks = rng.integers(0, 50, (S, R)).astype(np.int32)
+    adds = np.zeros((S, E, R), np.int32)
+    rms = np.zeros((S, E, R), np.int32)
+    for s in range(S):
+        # dots below the clock (live adds), horizons below the clock
+        mask = rng.random((E, R)) < 0.3
+        adds[s] = np.where(mask, rng.integers(1, 50, (E, R)), 0)
+        adds[s] = np.minimum(adds[s], clocks[s][None, :])
+        rmask = rng.random((E, R)) < 0.1
+        rms[s] = np.where(rmask & ~mask, rng.integers(1, 50, (E, R)), 0)
+        rms[s] = np.minimum(rms[s], clocks[s][None, :] + 5)
+        # normalize as the fold would
+        adds[s] = np.where(adds[s] > rms[s], adds[s], 0)
+        rms[s] = np.where(rms[s] > clocks[s][None, :], rms[s], 0)
+
+    ct, at_, rt = K.orset_merge_many(clocks, adds, rms, impl="tree")
+    cp, ap, rp = orset_merge_many_pallas(clocks, adds, rms, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ct), np.asarray(cp))
+    np.testing.assert_array_equal(np.asarray(at_), np.asarray(ap))
+    np.testing.assert_array_equal(np.asarray(rt), np.asarray(rp))
+
+
+def test_pallas_merge_single_state_is_identity():
+    clocks = np.array([[3, 0, 1]], np.int32)
+    adds = np.array([[[3, 0, 0], [0, 0, 1]]], np.int32)
+    rms = np.zeros((1, 2, 3), np.int32)
+    c, a, r = orset_merge_many_pallas(clocks, adds, rms, interpret=True)
+    np.testing.assert_array_equal(np.asarray(c), clocks[0])
+    np.testing.assert_array_equal(np.asarray(a), adds[0])
+    np.testing.assert_array_equal(np.asarray(r), rms[0])
