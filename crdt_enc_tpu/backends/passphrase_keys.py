@@ -145,7 +145,9 @@ class PassphraseKeyCryptor(PlainKeyCryptor):
             # concurrent to_thread workers share the cache; the lock keeps
             # the evict-then-insert pair atomic (a double-pop would raise)
             with self._kdf_cache_lock:
-                if len(self._kdf_cache) >= 64:  # hostile metas can't flood it
+                # evict only on real growth: a concurrent duplicate insert
+                # must not push out an unrelated cached derivation
+                if ck not in self._kdf_cache and len(self._kdf_cache) >= 64:
                     self._kdf_cache.pop(next(iter(self._kdf_cache)), None)
                 self._kdf_cache[ck] = key
         return key

@@ -30,7 +30,9 @@ import jax.numpy as jnp
 from .columnar import KIND_ADD, KIND_RM
 
 
-@partial(jax.jit, static_argnames=("num_members", "num_replicas"))
+@partial(
+    jax.jit, static_argnames=("num_members", "num_replicas", "sort_segments")
+)
 def orset_fold(
     clock0: jax.Array,  # (R,) int32
     add0: jax.Array,  # (E, R) int32
@@ -42,11 +44,17 @@ def orset_fold(
     *,
     num_members: int,
     num_replicas: int,
+    sort_segments: bool = False,
 ):
     """Fold an op batch into normalized ORSet planes.
 
     Returns ``(clock, add, rm)`` in canonical/normalized form: entries
     zeroed where ``add ≤ rm``, horizons zeroed where ``rm ≤ clock``.
+
+    ``sort_segments=True`` sorts the batch by segment id first and tells
+    XLA the scatter indices are sorted — random scatter is the weak spot
+    of the TPU memory system, while its sort is fast; which variant wins
+    depends on N vs E*R (bench both on hardware, see bench.py).
     """
     E, R = num_members, num_replicas
     pad = actor >= R  # sentinel rows from bucket padding
@@ -59,19 +67,28 @@ def orset_fold(
     live_add = is_add & ~seen
 
     seg = member * R + actor_ix
-    add_new = jax.ops.segment_max(
-        jnp.where(live_add, counter, 0), seg, num_segments=E * R
-    )
-    rm_new = jax.ops.segment_max(jnp.where(is_rm, counter, 0), seg, num_segments=E * R)
+    vals_add = jnp.where(live_add, counter, 0)
+    vals_rm = jnp.where(is_rm, counter, 0)
+    if sort_segments:
+        order = jnp.argsort(seg)
+        seg_s = seg[order]
+        add_new = jax.ops.segment_max(
+            vals_add[order], seg_s, num_segments=E * R, indices_are_sorted=True
+        )
+        rm_new = jax.ops.segment_max(
+            vals_rm[order], seg_s, num_segments=E * R, indices_are_sorted=True
+        )
+    else:
+        add_new = jax.ops.segment_max(vals_add, seg, num_segments=E * R)
+        rm_new = jax.ops.segment_max(vals_rm, seg, num_segments=E * R)
     # clamp empty segments (dtype-min fill) back to "absent"
     add_new = jnp.maximum(add_new, 0).reshape(E, R)
     rm_new = jnp.maximum(rm_new, 0).reshape(E, R)
 
-    # Adds advance the global clock; removes never do.
-    clock_new = jax.ops.segment_max(
-        jnp.where(live_add, counter, 0), actor_ix, num_segments=R
-    )
-    clock = jnp.maximum(clock0, jnp.maximum(clock_new, 0))
+    # Adds advance the global clock; removes never do.  The batch's max
+    # live-add counter per actor is already in add_new — a dense column
+    # reduction instead of a third scatter.
+    clock = jnp.maximum(clock0, jnp.max(add_new, axis=0, initial=0))
 
     add = jnp.maximum(add0, add_new)
     rm = jnp.maximum(rm0, rm_new)
@@ -81,6 +98,22 @@ def orset_fold(
     add = jnp.where(add > rm, add, 0)
     rm = jnp.where(rm > clock[None, :], rm, 0)
     return clock, add, rm
+
+
+def merge_rule(clock_a, add_a, rm_a, clock_b, add_b, rm_b, clock_merged):
+    """The clock-filter merge on raw arrays (clocks already row-broadcast
+    ready, ``clock_merged = max(clock_a, clock_b)`` supplied by the
+    caller).  Single source of truth for the Orswot merge semantics —
+    used by ``orset_merge`` AND the Pallas streaming kernel
+    (ops/pallas_merge.py), which must never diverge."""
+    same = add_a == add_b
+    surv_a = jnp.where(same | (add_a > clock_b), add_a, 0)
+    surv_b = jnp.where(same | (add_b > clock_a), add_b, 0)
+    add = jnp.maximum(surv_a, surv_b)
+    rm = jnp.maximum(rm_a, rm_b)
+    add = jnp.where(add > rm, add, 0)
+    rm = jnp.where(rm > clock_merged, rm, 0)
+    return add, rm
 
 
 @jax.jit
@@ -96,13 +129,10 @@ def orset_merge(
     replicas) vocabularies.  Pure elementwise — the tombstone-free
     clock-filter rule (see crdt_enc_tpu/models/orset.py module docs)."""
     clock = jnp.maximum(clock_a, clock_b)
-    same = add_a == add_b
-    surv_a = jnp.where(same | (add_a > clock_b[None, :]), add_a, 0)
-    surv_b = jnp.where(same | (add_b > clock_a[None, :]), add_b, 0)
-    add = jnp.maximum(surv_a, surv_b)
-    rm = jnp.maximum(rm_a, rm_b)
-    add = jnp.where(add > rm, add, 0)
-    rm = jnp.where(rm > clock[None, :], rm, 0)
+    add, rm = merge_rule(
+        clock_a[None, :], add_a, rm_a, clock_b[None, :], add_b, rm_b,
+        clock[None, :],
+    )
     return clock, add, rm
 
 
@@ -131,6 +161,8 @@ def orset_merge_many(
         return orset_merge_many_pallas(
             c, a, r, interpret=jax.default_backend() != "tpu"
         )
+    if impl != "tree":
+        raise ValueError(f"unknown merge impl {impl!r}; use 'tree' or 'pallas'")
     while c.shape[0] > 1:
         s = c.shape[0]
         half = s // 2

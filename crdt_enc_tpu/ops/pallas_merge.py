@@ -43,20 +43,16 @@ def _merge_step_kernel(clocks_ref, prev_run_ref, run_ref, adds_ref, rms_ref,
 
     @pl.when(s > 0)
     def _():
-        a = out_add_ref[...]
-        b = adds_ref[0]
+        from .orset import merge_rule
+
         # clocks stay (1, R)-shaped and broadcast over the member sublanes
-        # (keeps every intermediate ≥2-D for Mosaic)
-        clock_a = prev_run_ref[...]  # clock of the accumulated left fold
-        clock_b = clocks_ref[...]
-        same = a == b
-        surv_a = jnp.where(same | (a > clock_b), a, 0)
-        surv_b = jnp.where(same | (b > clock_a), b, 0)
-        add = jnp.maximum(surv_a, surv_b)
-        rm = jnp.maximum(out_rm_ref[...], rms_ref[0])
-        run = run_ref[...]  # merged clock after this step
-        add = jnp.where(add > rm, add, 0)
-        rm = jnp.where(rm > run, rm, 0)
+        # (keeps every intermediate ≥2-D for Mosaic); prev_run is the clock
+        # of the accumulated left fold, run the merged clock after this step
+        add, rm = merge_rule(
+            prev_run_ref[...], out_add_ref[...], out_rm_ref[...],
+            clocks_ref[...], adds_ref[0], rms_ref[0],
+            run_ref[...],
+        )
         out_add_ref[...] = add
         out_rm_ref[...] = rm
 

@@ -64,7 +64,7 @@ def fixed_vocabs():
     return K.Vocab(MEMBERS), K.Vocab(ACTORS)
 
 
-def fold_on_device(initial: ORSet, ops, pad_to=None):
+def fold_on_device(initial: ORSet, ops, pad_to=None, sort_segments=False):
     """Host initial state + op batch → kernel fold → host state."""
     members, replicas = fixed_vocabs()
     clock0, add0, rm0 = K.orset_state_to_planes(initial, members, replicas)
@@ -88,6 +88,7 @@ def fold_on_device(initial: ORSet, ops, pad_to=None):
         cols.counter,
         num_members=E,
         num_replicas=R,
+        sort_segments=sort_segments,
     )
     return K.orset_planes_to_state(clock, add, rm, members, replicas)
 
@@ -99,6 +100,17 @@ def test_orset_fold_matches_host(script):
     if not ops:
         return
     device = fold_on_device(ORSet(), ops)
+    assert canonical_bytes(device) == canonical_bytes(host)
+
+
+@settings(max_examples=60, deadline=None)
+@given(orset_script)
+def test_orset_fold_sorted_segments_matches_host(script):
+    """The sorted-scatter variant must be bit-identical to the default."""
+    host, ops = run_script(script)
+    if not ops:
+        return
+    device = fold_on_device(ORSet(), ops, sort_segments=True)
     assert canonical_bytes(device) == canonical_bytes(host)
 
 
