@@ -64,7 +64,7 @@ def fixed_vocabs():
     return K.Vocab(MEMBERS), K.Vocab(ACTORS)
 
 
-def fold_on_device(initial: ORSet, ops, pad_to=None, sort_segments=False):
+def fold_on_device(initial: ORSet, ops, pad_to=None, **fold_kw):
     """Host initial state + op batch → kernel fold → host state."""
     members, replicas = fixed_vocabs()
     clock0, add0, rm0 = K.orset_state_to_planes(initial, members, replicas)
@@ -88,7 +88,7 @@ def fold_on_device(initial: ORSet, ops, pad_to=None, sort_segments=False):
         cols.counter,
         num_members=E,
         num_replicas=R,
-        sort_segments=sort_segments,
+        **fold_kw,
     )
     return K.orset_planes_to_state(clock, add, rm, members, replicas)
 
@@ -110,8 +110,35 @@ def test_orset_fold_sorted_segments_matches_host(script):
     host, ops = run_script(script)
     if not ops:
         return
-    device = fold_on_device(ORSet(), ops, sort_segments=True)
+    device = fold_on_device(
+        ORSet(), ops, impl="two_pass", sort_segments=True
+    )
     assert canonical_bytes(device) == canonical_bytes(host)
+
+
+@settings(max_examples=60, deadline=None)
+@given(orset_script)
+def test_orset_fold_two_pass_matches_host(script):
+    """The original two-scatter variant must stay bit-identical."""
+    host, ops = run_script(script)
+    if not ops:
+        return
+    device = fold_on_device(ORSet(), ops, impl="two_pass")
+    assert canonical_bytes(device) == canonical_bytes(host)
+
+
+@settings(max_examples=60, deadline=None)
+@given(orset_script, orset_script)
+def test_orset_fold_fused_i16_from_nonempty_state(script_a, script_b):
+    """int16 fast path (counters < 2**15), incl. nonzero initial planes."""
+    base, _ = run_script(script_a)
+    host2, ops = run_script(script_b, ORSet.from_obj(base.to_obj()))
+    if not ops:
+        return
+    device = fold_on_device(
+        ORSet.from_obj(base.to_obj()), ops, small_counters=True
+    )
+    assert canonical_bytes(device) == canonical_bytes(host2)
 
 
 @settings(max_examples=60, deadline=None)
