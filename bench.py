@@ -39,6 +39,21 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# Measured spread of tunnel round-trip jitter on this host (single source of
+# truth — benchmarks/suite.py imports it): a marginal per-fold time below
+# TUNNEL_JITTER_S / chain is noise, not device time.
+TUNNEL_JITTER_S = 40e-3
+
+
+def force_completion(out):
+    """``block_until_ready`` alone can return before the tunneled TPU has
+    materialized results; pulling one scalar to host forces it."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf).ravel()[:1]
+
+
 def gen_columns(N: int, R: int, E: int, seed: int = 7):
     """Vectorized op-stream generator: per-actor sequential add dots,
     ~10% removes whose horizon is the actor's add-count so far."""
@@ -98,28 +113,46 @@ def main():
     log(f"device: {dev.platform} ({dev.device_kind}); N={N} R={R} E={E}")
 
     kind, member, actor, counter = gen_columns(N, R, E)
+    small = bool(counter.max() < 2 ** 15)
+    variant_kws = {
+        "fused": dict(impl="fused"),
+        "two_pass": dict(impl="two_pass"),
+    }
+    if small:
+        variant_kws["fused_i16"] = dict(impl="fused", small_counters=True)
 
-    # ---- correctness spot-check: host vs TPU byte equality on a subsample
+    # ---- correctness spot-check: host vs TPU byte equality on a subsample,
+    # for EVERY variant that competes below (the published number must come
+    # from a checked code path)
     n_chk = min(N, 20_000)
     h_state, _ = host_fold(kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk], R)
     from crdt_enc_tpu.ops.columnar import Vocab, orset_planes_to_state
+    from crdt_enc_tpu.utils import codec
 
     mem_v = Vocab(range(E))
     rep_v = Vocab(range(R))
     c0 = np.zeros(R, np.int32)
     a0 = np.zeros((E, R), np.int32)
     r0 = np.zeros((E, R), np.int32)
-    ck, ad, rmv = K.orset_fold(
-        c0, a0, r0, kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk],
-        num_members=E, num_replicas=R,
-    )
-    t_state = orset_planes_to_state(np.asarray(ck), np.asarray(ad), np.asarray(rmv), mem_v, rep_v)
-    from crdt_enc_tpu.utils import codec
-
-    ok = codec.pack(t_state.to_obj()) == codec.pack(h_state.to_obj())
-    log(f"byte-equality (n={n_chk}): {'OK' if ok else 'MISMATCH'}")
-    if not ok:
-        log("WARNING: TPU fold diverged from host reference on subsample")
+    h_bytes = codec.pack(h_state.to_obj())
+    diverged = []
+    for name, kw in variant_kws.items():
+        ck, ad, rmv = K.orset_fold(
+            c0, a0, r0, kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk],
+            num_members=E, num_replicas=R, **kw,
+        )
+        t_state = orset_planes_to_state(
+            np.asarray(ck), np.asarray(ad), np.asarray(rmv), mem_v, rep_v
+        )
+        ok = codec.pack(t_state.to_obj()) == h_bytes
+        log(f"byte-equality[{name}] (n={n_chk}): {'OK' if ok else 'MISMATCH'}")
+        if not ok:
+            log(f"WARNING: variant {name} diverged from host reference; excluded")
+            diverged.append(name)
+    for name in diverged:
+        del variant_kws[name]
+    if not variant_kws:
+        raise SystemExit("every fold variant diverged from the host reference")
 
     # ---- single-core host baseline (capped subsample; O(n) per-op loop)
     _, t_host = host_fold(kind[:N_HOST], member[:N_HOST], actor[:N_HOST], counter[:N_HOST], R)
@@ -133,7 +166,6 @@ def main():
     # signal clears the ~±20ms tunnel-latency jitter.
     CHAIN = int(os.environ.get("BENCH_CHAIN", 1000 if smoke else 20))
     args = [jax.device_put(x, dev) for x in (c0, a0, r0, kind, member, actor, counter)]
-    small = bool(counter.max() < 2 ** 15)
 
     def chained(n_folds, **kw):
         @jax.jit
@@ -158,29 +190,40 @@ def main():
             t0 = time.perf_counter()
             out = fn(*args)
             jax.block_until_ready(out)
-            np.asarray(out[0])[0]  # force real completion through the tunnel
+            force_completion(out)
             times.append(time.perf_counter() - t0)
         return min(times)
 
-    variant_kws = {
-        "fused": dict(impl="fused"),
-        "two_pass": dict(impl="two_pass"),
-    }
-    if small:
-        variant_kws["fused_i16"] = dict(impl="fused", small_counters=True)
-    variants = {}
+    # Below this marginal the measurement is tunnel jitter, not device time
+    # (jitter spread over CHAIN folds).  A variant whose marginal lands
+    # under the floor is NOISE — it must not win "best" and its rate must
+    # not be published; raise BENCH_CHAIN until the signal clears the floor.
+    NOISE_FLOOR = TUNNEL_JITTER_S / CHAIN
+    variants, single_dispatch = {}, {}
     for name, kw in variant_kws.items():
         t1 = timed(chained(1, **kw))
         tk = timed(chained(1 + CHAIN, **kw))
-        # a fold can never beat the single-dispatch jitter floor entirely;
-        # clamp so noise can't produce a nonsense (or negative) marginal
-        t_marginal = max((tk - t1) / CHAIN, 20e-6)
-        variants[name] = t_marginal
+        t_marginal = (tk - t1) / CHAIN
+        reliable = t_marginal > NOISE_FLOOR
+        single_dispatch[name] = t1
+        if reliable:
+            variants[name] = t_marginal
         log(
             f"tpu[{name}]: single-dispatch {t1:.4f}s (incl. ~0.1s tunnel "
             f"round-trip); marginal {t_marginal * 1e3:.2f}ms/fold → "
-            f"{N / t_marginal:,.0f} ops/s"
+            f"{N / max(t_marginal, 1e-9):,.0f} ops/s"
+            + ("" if reliable else "  [below noise floor — excluded]")
         )
+    method = "marginal_chain"
+    if not variants:
+        log(
+            f"WARNING: every variant fell below the {NOISE_FLOOR * 1e3:.2f}ms "
+            f"noise floor; rerun with a larger BENCH_CHAIN (current {CHAIN}). "
+            "Falling back to single-dispatch wall-clock (tunnel latency "
+            "INCLUDED) — a strict over-estimate of device time."
+        )
+        variants = single_dispatch
+        method = "single_dispatch_upper_bound"
     best = min(variants, key=variants.get)
     t_tpu = variants[best]
     tpu_rate = N / t_tpu
@@ -191,6 +234,9 @@ def main():
         "value": round(tpu_rate, 1),
         "unit": "ops/s",
         "vs_baseline": round(tpu_rate / host_rate, 2),
+        # which timing method produced `value` — consumers must not compare
+        # a latency-bound fallback number against a marginal-chain number
+        "method": method,
     }))
 
 

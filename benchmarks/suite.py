@@ -2,9 +2,15 @@
 
 Each config measures: single-core host-reference fold rate (the per-op
 loop the reference runs, capped to a subsample for the big configs — the
-loop is O(n) so per-op rate transfers), device fold rate (compile
-excluded, best of ITERS), and a byte-equality check of the folded state
-against the host reference on a common subsample.
+loop is O(n) so per-op rate transfers), device fold rate, and a
+byte-equality check of the folded state against the host reference on a
+common subsample.
+
+Configs 1-4 time the fold as the MARGINAL cost inside a chained
+``lax.scan`` (``timeit_marginal``) so the ~100ms tunnel dispatch latency
+cancels; config 5 is an end-to-end streaming pipeline (decrypt → decode →
+fold) timed wall-clock, dispatch latency included — there the host-side
+crypto/decode dominates and end-to-end is the honest number.
 
 Run:  python benchmarks/suite.py [--smoke] [--config N] [--cpu]
 Prints one JSON line per config and a trailing summary line.
@@ -48,13 +54,46 @@ def running_count(group: np.ndarray, n_groups: int) -> np.ndarray:
 def timeit(fn, iters: int) -> float:
     import jax
 
+    from bench import force_completion
+
     jax.block_until_ready(fn())  # compile + warmup
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        out = fn()
+        jax.block_until_ready(out)
+        force_completion(out)
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def timeit_marginal(make_chained, iters: int, chain: int) -> tuple[float, str]:
+    """Per-fold device time as the marginal cost inside a chained scan.
+
+    ``make_chained(n)`` returns a zero-arg callable running n
+    data-dependent folds in ONE dispatch.  The TPU here sits behind a
+    tunnel with ~100ms fixed dispatch latency, so single-dispatch timing
+    overstates small folds ~5-100x; the chained difference cancels the
+    latency (same method and jitter constant as bench.py).  Falls back to
+    single-dispatch wall-clock (latency INCLUDED — a strict over-estimate)
+    when the marginal signal is below the jitter noise floor.
+
+    Returns ``(seconds_per_fold, method)`` where method is
+    ``"marginal_chain"`` or ``"single_dispatch_upper_bound"``."""
+    from bench import TUNNEL_JITTER_S
+
+    t1 = timeit(make_chained(1), iters)
+    tk = timeit(make_chained(1 + chain), iters)
+    marginal = (tk - t1) / chain
+    floor = TUNNEL_JITTER_S / chain
+    if marginal <= floor:
+        log(
+            f"  marginal {marginal * 1e3:.3f}ms/fold below noise floor "
+            f"{floor * 1e3:.3f}ms; using single-dispatch {t1 * 1e3:.1f}ms "
+            "(tunnel latency included)"
+        )
+        return t1, "single_dispatch_upper_bound"
+    return marginal, "marginal_chain"
 
 
 def actor_bytes_table(R: int) -> list:
@@ -65,7 +104,7 @@ def actor_bytes_table(R: int) -> list:
 # --------------------------------------------------------------- config 1+2
 
 
-def bench_gcounter(N: int, R: int, iters: int) -> dict:
+def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     """Config 1: G-Counter, 4 replicas, 1k increment ops."""
     import jax
 
@@ -86,19 +125,29 @@ def bench_gcounter(N: int, R: int, iters: int) -> dict:
 
     clock0 = np.zeros(R, np.int32)
     dev_args = [jax.device_put(x) for x in (clock0, actor, counter)]
-    t_dev = timeit(
-        lambda: K.gcounter_fold(*dev_args, num_replicas=R), iters
-    )
+
+    def make_chained(n):
+        @jax.jit
+        def run(clock0, actor, counter):
+            def body(carry, _):
+                clock, total = K.gcounter_fold(carry, actor, counter, num_replicas=R)
+                return clock, total
+            return jax.lax.scan(body, clock0, None, length=n)
+        return lambda: run(*dev_args)
+
+    # µs-scale fold: a long chain is the only way past the dispatch jitter
+    t_dev, timing = timeit_marginal(make_chained, iters, chain=50_000)
     clock, total = K.gcounter_fold(*dev_args, num_replicas=R)
     dev_clock = {actors[i]: int(c) for i, c in enumerate(np.asarray(clock)) if c}
     equal = dev_clock == state.clock.counters and int(total) == state.read()
     return dict(
         config="gcounter_4x1k", metric="ops_folded_per_sec", N=N, R=R,
         host_rate=N / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+        timing=timing,
     )
 
 
-def bench_pncounter(N: int, R: int, iters: int) -> dict:
+def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     """Config 2: PN-Counter, 1k replicas, 100k mixed inc/dec ops."""
     import jax
 
@@ -125,9 +174,19 @@ def bench_pncounter(N: int, R: int, iters: int) -> dict:
     p0 = np.zeros(R, np.int32)
     n0 = np.zeros(R, np.int32)
     dev_args = [jax.device_put(x) for x in (p0, n0, sign, actor, counter)]
-    t_dev = timeit(
-        lambda: K.pncounter_fold(*dev_args, num_replicas=R), iters
-    )
+
+    def make_chained(n):
+        @jax.jit
+        def run(p0, n0, sign, actor, counter):
+            def body(carry, _):
+                p, nn, value = K.pncounter_fold(
+                    *carry, sign, actor, counter, num_replicas=R
+                )
+                return (p, nn), value
+            return jax.lax.scan(body, (p0, n0), None, length=n)
+        return lambda: run(*dev_args)
+
+    t_dev, timing = timeit_marginal(make_chained, iters, chain=5_000 * cmul)
     # byte equality on the host subsample
     ps, ns, val = K.pncounter_fold(
         p0, n0, sign[:n_host], actor[:n_host], counter[:n_host], num_replicas=R
@@ -142,13 +201,14 @@ def bench_pncounter(N: int, R: int, iters: int) -> dict:
     return dict(
         config="pncounter_1kx100k", metric="ops_folded_per_sec", N=N, R=R,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+        timing=timing,
     )
 
 
 # ----------------------------------------------------------------- config 3
 
 
-def bench_orset(N: int, R: int, E: int, n_host: int, iters: int) -> dict:
+def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) -> dict:
     """Config 3 (north star): OR-Set, 10k replicas, 1M add/remove ops."""
     import jax
 
@@ -180,21 +240,37 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int) -> dict:
         kind[:n_host], member[:n_host], actor[:n_host], counter[:n_host], R
     )
     args = [jax.device_put(x) for x in (c0, a0, r0, kind, member, actor, counter)]
-    t_dev = timeit(
-        lambda: K.orset_fold(*args, num_members=E, num_replicas=R), iters
-    )
+
+    def make_chained(n):
+        @jax.jit
+        def run(c, a, r, kind, member, actor, counter):
+            def body(carry, _):
+                return (
+                    K.orset_fold(
+                        *carry, kind, member, actor, counter,
+                        num_members=E, num_replicas=R,
+                    ),
+                    (),
+                )
+            carry, _ = jax.lax.scan(body, (c, a, r), None, length=n)
+            return carry
+        return lambda: run(*args)
+
+    t_dev, timing = timeit_marginal(make_chained, iters, chain=20 * cmul)
     return dict(
         config="orset_10kx1M", metric="ops_folded_per_sec", N=N, R=R, E=E,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+        timing=timing,
     )
 
 
 # ----------------------------------------------------------------- config 4
 
 
-def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int) -> dict:
+def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int = 1) -> dict:
     """Config 4: LWW-map, 1M keys, 10k replicas, timestamped writes."""
     import jax
+    import jax.numpy as jnp
 
     from crdt_enc_tpu import ops as K
     from crdt_enc_tpu.models import LWWMap
@@ -220,13 +296,52 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int) -> dict:
     t_host = time.perf_counter() - t0
 
     args = [jax.device_put(x) for x in (key, hi, lo, actor, value)]
-    t_dev = timeit(lambda: K.lww_fold(*args, num_keys=K_keys), iters)
 
-    # byte equality on the host subsample
-    m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
+    def make_chained(n):
+        @jax.jit
+        def run(key, hi, lo, actor, value):
+            win0 = (
+                jnp.full(K_keys, -1, jnp.int32),
+                jnp.full(K_keys, -1, jnp.int32),
+                jnp.full(K_keys, -1, jnp.int32),
+                jnp.full(K_keys, -1, jnp.int32),
+                jnp.zeros(K_keys, bool),
+            )
+
+            def body(carry, _):
+                return (
+                    K.lww_fold_into(
+                        carry, key, hi, lo, actor, value, num_keys=K_keys
+                    ),
+                    (),
+                )
+
+            carry, _ = jax.lax.scan(body, win0, None, length=n)
+            return carry
+        return lambda: run(*args)
+
+    # NOTE: each chained fold competes N new rows + K_keys carried winners,
+    # so device_rate = N / t_dev UNDERSTATES per-row throughput (by up to
+    # ~2x when K_keys ≈ N) — conservative by construction.
+    t_dev, timing = timeit_marginal(make_chained, iters, chain=20 * cmul)
+
+    # The timed path is lww_fold_into: check IT (incremental, two halves)
+    # against the whole-batch fold on the host subsample, then the whole
+    # fold against the host reference
+    h2 = n_host // 2
+    inc = K.lww_fold_into(
+        K.lww_fold(key[:h2], hi[:h2], lo[:h2], actor[:h2], value[:h2], num_keys=K_keys),
+        key[h2:n_host], hi[h2:n_host], lo[h2:n_host], actor[h2:n_host],
+        value[h2:n_host], num_keys=K_keys,
+    )
+    whole = K.lww_fold(
         key[:n_host], hi[:n_host], lo[:n_host], actor[:n_host], value[:n_host],
         num_keys=K_keys,
     )
+    inc_equal = all(
+        np.array_equal(np.asarray(a), np.asarray(b)) for a, b in zip(whole, inc)
+    )
+    m_hi, m_lo, m_actor, m_value, present = whole
     m_hi, m_lo = np.asarray(m_hi), np.asarray(m_lo)
     m_actor, m_value = np.asarray(m_actor), np.asarray(m_value)
     idx = np.flatnonzero(np.asarray(present))
@@ -240,11 +355,12 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int) -> dict:
         ]
         for k in idx
     }
-    equal = dev_map == state
+    equal = (dev_map == state) and inc_equal
     return dict(
         config="lwwmap_1Mx10k", metric="writes_folded_per_sec", N=N,
         K=K_keys, R=R,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
+        timing=timing,
     )
 
 
@@ -379,6 +495,7 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
         config="mixed_streaming_100k", metric="ops_streamed_per_sec",
         N=total_ops, R=R, E=E, files=n_files,
         host_rate=host_rate, device_rate=dev_rate, byte_equal=bool(equal),
+        timing="end_to_end",
     )
 
 
@@ -408,16 +525,20 @@ def main():
     def S(n, lo=64):
         return max(lo, int(n * scale))
 
+    # smaller configs fold faster: lengthen the timing chain so the
+    # marginal signal still clears the dispatch-jitter noise floor
+    cmul = max(1, min(100, round(1.0 / max(scale, 0.01))))
+
     runners = {
-        1: lambda: bench_gcounter(S(1_000), 4, args.iters),
-        2: lambda: bench_pncounter(S(100_000), min(1_000, S(1_000)), args.iters),
+        1: lambda: bench_gcounter(S(1_000), 4, args.iters, cmul),
+        2: lambda: bench_pncounter(S(100_000), min(1_000, S(1_000)), args.iters, cmul),
         3: lambda: bench_orset(
             S(1_000_000), min(10_000, S(10_000)), min(4096, S(4096)),
-            n_host=S(100_000, lo=2_000), iters=args.iters,
+            n_host=S(100_000, lo=2_000), iters=args.iters, cmul=cmul,
         ),
         4: lambda: bench_lwwmap(
             S(1_000_000), min(1_000_000, S(1_000_000)), min(10_000, S(10_000)),
-            n_host=S(50_000, lo=2_000), iters=args.iters,
+            n_host=S(50_000, lo=2_000), iters=args.iters, cmul=cmul,
         ),
         5: lambda: bench_streaming(
             S(200_000), min(100_000, S(100_000)), min(1024, S(1024)),

@@ -16,7 +16,7 @@ from .columnar import (
     vclock_to_dense,
 )
 from .counters import gcounter_fold, pncounter_fold, vclock_merge
-from .lww import lww_fold
+from .lww import lww_fold, lww_fold_into
 from .mvreg import mvreg_dominance_keep
 from .orset import orset_fold, orset_merge, orset_merge_many
 
@@ -31,6 +31,7 @@ __all__ = [
     "dense_to_vclock",
     "gcounter_fold",
     "lww_fold",
+    "lww_fold_into",
     "lww_ops_to_columns",
     "mvreg_dominance_keep",
     "orset_fold",

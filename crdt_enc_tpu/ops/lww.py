@@ -60,3 +60,34 @@ def lww_fold(
     elig, m_value = cascade(elig, value)
     present = m_hi > -1
     return m_hi, m_lo, m_actor, m_value, present
+
+
+@partial(jax.jit, static_argnames=("num_keys",))
+def lww_fold_into(
+    win: tuple,  # (win_hi, win_lo, win_actor, win_value, present) — (K,) each
+    key: jax.Array,
+    ts_hi: jax.Array,
+    ts_lo: jax.Array,
+    actor: jax.Array,
+    value: jax.Array,
+    *,
+    num_keys: int,
+):
+    """Incremental fold: new rows compete against an existing winner table.
+
+    The current winners re-enter as candidate rows (absent keys as padding),
+    so ``fold_into(fold(A), B) == fold(A ++ B)`` — the LWW tie-break is a
+    total order, making the fold associative.  This is the merge step for
+    folding op batches that arrive in waves (and the data dependence the
+    benchmark's chained timing needs)."""
+    K = num_keys
+    w_hi, w_lo, w_actor, w_value, present = win
+    prev_key = jnp.where(present, jnp.arange(K, dtype=key.dtype), K)
+    return lww_fold(
+        jnp.concatenate([key, prev_key]),
+        jnp.concatenate([ts_hi, w_hi]),
+        jnp.concatenate([ts_lo, w_lo]),
+        jnp.concatenate([actor, w_actor]),
+        jnp.concatenate([value, w_value]),
+        num_keys=K,
+    )

@@ -1,0 +1,329 @@
+"""Fault-injection tests for the crash-safety-by-ordering design.
+
+The reference has no fault tests (SURVEY.md §5); its safety story is
+structural — immutable ``create_new`` + fsync writes, content-addressed
+names, store-new-before-delete-old (crdt-enc-tokio lib.rs:326-432, core
+lib.rs:362-369, 653-661).  These tests *prove* the structure: a simulated
+process death at every dangerous point between a durable write and its
+follow-up must leave the remote in a state every replica still converges
+from, and a re-run must clean up rather than corrupt.
+
+``CrashStorage`` wraps a real backend and raises ``SimulatedCrash`` when a
+named method is hit — before the call (the write never happened) or after
+it (the write is durable but the caller's bookkeeping is lost), which is
+exactly the fault model of a kill -9 between two syscalls.
+"""
+
+import asyncio
+
+import pytest
+
+from crdt_enc_tpu.backends import FsStorage, IdentityCryptor, PlainKeyCryptor
+from crdt_enc_tpu.core import Core, OpenOptions, gcounter_adapter, orset_adapter
+from crdt_enc_tpu.models import canonical_bytes
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+class SimulatedCrash(Exception):
+    pass
+
+
+class CrashStorage:
+    """Delegate to ``inner``, but die at an injection point.
+
+    ``crash_on``: method name; ``when``: "before" (call never runs) or
+    "after" (call completes — its effects are durable — then we die);
+    ``skip``: let that many calls through first.  The trap disarms after
+    firing once, modelling a process that restarts and does not crash
+    again at the same point.
+    """
+
+    def __init__(self, inner, crash_on: str, when: str = "before", skip: int = 0):
+        assert when in ("before", "after")
+        self._inner = inner
+        self._crash_on = crash_on
+        self._when = when
+        self._remaining = skip
+        self.armed = True
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name != self._crash_on or not callable(attr):
+            return attr
+
+        async def trapped(*args, **kwargs):
+            if not self.armed:
+                return await attr(*args, **kwargs)
+            if self._remaining > 0:
+                self._remaining -= 1
+                return await attr(*args, **kwargs)
+            self.armed = False
+            if self._when == "before":
+                raise SimulatedCrash(f"crash before {name}")
+            result = await attr(*args, **kwargs)
+            raise SimulatedCrash(f"crash after {name}")
+
+        return trapped
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_opts(storage, adapter, create=True):
+    return OpenOptions(
+        storage=storage,
+        cryptor=IdentityCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=adapter,
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=create,
+    )
+
+
+@pytest.fixture
+def fs_factory(tmp_path):
+    remote_dir = tmp_path / "remote"
+    counter = iter(range(1000))
+    return lambda: FsStorage(str(tmp_path / f"local{next(counter)}"), str(remote_dir))
+
+
+async def _seed_orset(fs_factory):
+    """One replica writes a few ops; returns its canonical state bytes."""
+    c = await Core.open(make_opts(fs_factory(), orset_adapter()))
+    for m in (b"a", b"b", b"c"):
+        await c.update(lambda s, m=m: s.add_ctx(c.actor_id, m))
+    await c.update(lambda s: s.rm_ctx(b"b"))
+    return c.with_state(canonical_bytes)
+
+
+def test_crash_between_snapshot_write_and_state_gc(fs_factory):
+    """Die after the new snapshot is durable but before old states are
+    removed: both snapshots remain; readers merge them (idempotent) and a
+    re-run of compact finishes the GC."""
+
+    async def go():
+        await _seed_orset(fs_factory)
+        # first compaction succeeds → one state file exists
+        c1 = await Core.open(make_opts(fs_factory(), orset_adapter()))
+        await c1.read_remote()
+        await c1.compact()
+        await c1.update(lambda s: s.add_ctx(c1.actor_id, b"d"))
+
+        crashy = CrashStorage(fs_factory(), "remove_states", when="before")
+        c2 = await Core.open(make_opts(crashy, orset_adapter()))
+        with pytest.raises(SimulatedCrash):
+            await c2.compact()
+
+        # remote now holds the old snapshot, the new snapshot, and
+        # possibly op files remove_ops didn't get to — every combination
+        # must fold to the same state.  Two independent readers of the
+        # dirty remote must agree byte-for-byte (not just on membership —
+        # clocks and dots must survive the crash intact too).
+        c3 = await Core.open(make_opts(fs_factory(), orset_adapter()))
+        await c3.read_remote()
+        assert c3.with_state(lambda s: s.members()) == [b"a", b"c", b"d"]
+        c3b = await Core.open(make_opts(fs_factory(), orset_adapter()))
+        await c3b.read_remote()
+        assert c3.with_state(canonical_bytes) == c3b.with_state(canonical_bytes)
+        # ...and byte-identically to the writer that survived
+        await c1.read_remote()
+        assert c1.with_state(canonical_bytes) == c3.with_state(canonical_bytes)
+
+        # re-running compact on a fresh replica completes the GC
+        await c3.compact()
+        clean = fs_factory()
+        assert len(await clean.list_state_names()) == 1
+        assert await clean.list_op_actors() == []
+
+    run(go())
+
+
+def test_crash_between_snapshot_write_and_op_gc(fs_factory):
+    """Die before op GC: the snapshot and the op files it covers coexist.
+    Readers fold the snapshot first, then skip the already-covered op
+    versions via the concurrent-read tolerance (lib.rs:521-525 semantics)."""
+
+    async def go():
+        await _seed_orset(fs_factory)
+        crashy = CrashStorage(fs_factory(), "remove_ops", when="before")
+        c1 = await Core.open(make_opts(crashy, orset_adapter()))
+        with pytest.raises(SimulatedCrash):
+            await c1.compact()
+
+        c2 = await Core.open(make_opts(fs_factory(), orset_adapter()))
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.members()) == [b"a", b"c"]
+        # both the snapshot and the covered ops are present right now
+        dirty = fs_factory()
+        assert len(await dirty.list_state_names()) == 1
+        assert len(await dirty.list_op_actors()) == 1
+
+        await c2.compact()
+        clean = fs_factory()
+        assert await clean.list_op_actors() == []
+        assert len(await clean.list_state_names()) == 1
+
+    run(go())
+
+
+def test_crash_in_meta_rewrite_leaves_mergeable_metas(fs_factory):
+    """Die between storing the rewritten remote-meta and deleting the
+    superseded files: multiple meta files remain, and because RemoteMeta is
+    a CRDT they merge on the next read — the key material survives."""
+
+    async def go():
+        c1 = await Core.open(make_opts(fs_factory(), gcounter_adapter()))
+        key1 = c1._data.keys.latest_key()
+        assert key1 is not None
+
+        # second replica's open rewrites meta (its read-notify-store cycle);
+        # crash it between store and delete
+        crashy = CrashStorage(fs_factory(), "remove_remote_metas", when="before")
+        try:
+            await Core.open(make_opts(crashy, gcounter_adapter()))
+        except SimulatedCrash:
+            pass
+
+        dirty = fs_factory()
+        assert len(await dirty.list_remote_meta_names()) >= 1
+
+        c3 = await Core.open(make_opts(fs_factory(), gcounter_adapter()))
+        key3 = c3._data.keys.latest_key()
+        assert key3 is not None
+        assert key3.id == key1.id and key3.material == key1.material
+
+    run(go())
+
+
+def test_crash_after_op_write_before_cursor_update(fs_factory, tmp_path):
+    """Die after the op file is durable but before the producer cursor is
+    persisted: on restart the replica must (a) recover the op's effect via
+    read_remote and (b) place its next write past the leaked file by
+    collision probing — never clobber it."""
+
+    async def go():
+        local = str(tmp_path / "producer")
+        remote = str(tmp_path / "remote")
+
+        crashy = CrashStorage(
+            FsStorage(local, remote), "store_local_meta", when="before", skip=1
+        )
+        c1 = await Core.open(make_opts(crashy, gcounter_adapter()))
+        actor = c1.actor_id
+        with pytest.raises(SimulatedCrash):
+            await c1.update(lambda s: s.inc(actor, 5))
+        # the op file is durable; the cursor write never happened
+
+        # restart the same replica (same local dir)
+        c2 = await Core.open(
+            make_opts(FsStorage(local, remote), gcounter_adapter(), create=False)
+        )
+        assert c2.actor_id == actor
+        await c2.read_remote()  # recovers the leaked op's effect
+        assert c2.with_state(lambda s: s.read()) == 5
+        await c2.update(lambda s: s.inc(actor, 7))
+
+        # an independent reader sees both increments, no gaps, no clobber
+        c3 = await Core.open(
+            make_opts(FsStorage(str(tmp_path / "reader"), remote), gcounter_adapter())
+        )
+        await c3.read_remote()
+        assert c3.with_state(lambda s: s.read()) == 12
+
+    run(go())
+
+
+def test_restart_without_read_remote_probes_past_leaked_file(fs_factory, tmp_path):
+    """Same fault as above, but the restarted replica writes immediately
+    (no read_remote): the durable cursor is stale, so the new op collides
+    with the leaked file and must probe forward to the next free version —
+    never clobber it.  (The written dot is derived from stale empty state,
+    so by G-Counter dot semantics the ops overlap and merge by max: a
+    reader converges to 7, the same value a host merge of both ops gives.)"""
+
+    async def go():
+        local = str(tmp_path / "producer")
+        remote = str(tmp_path / "remote")
+
+        crashy = CrashStorage(
+            FsStorage(local, remote), "store_local_meta", when="before", skip=1
+        )
+        c1 = await Core.open(make_opts(crashy, gcounter_adapter()))
+        actor = c1.actor_id
+        with pytest.raises(SimulatedCrash):
+            await c1.update(lambda s: s.inc(actor, 5))
+
+        c2 = await Core.open(
+            make_opts(FsStorage(local, remote), gcounter_adapter(), create=False)
+        )
+        await c2.update(lambda s: s.inc(actor, 7))  # collides at v1 → probes to v2
+
+        # both op files exist: the leaked v1 was not clobbered
+        dirty = FsStorage(str(tmp_path / "probe-local"), remote)
+        files = await dirty.load_ops([(actor, 1)])
+        assert [v for _, v, _ in files] == [1, 2]
+
+        c3 = await Core.open(
+            make_opts(FsStorage(str(tmp_path / "reader"), remote), gcounter_adapter())
+        )
+        await c3.read_remote()
+        assert c3.with_state(lambda s: s.read()) == 7
+
+    run(go())
+
+
+def test_torn_tmp_files_are_invisible(fs_factory, tmp_path):
+    """A crash mid-write leaves only ``.tmp-*`` files (tmp+fsync+link
+    publish).  Listings, op scans, and opens must not see them."""
+
+    async def go():
+        await _seed_orset(fs_factory)
+        remote = tmp_path / "remote"
+        # simulate torn writes in every remote family (states/ may not exist
+        # yet — no compaction has run — exactly like a crash mid-first-write)
+        (remote / "states").mkdir(exist_ok=True)
+        (remote / "states" / ".tmp-dead").write_bytes(b"\x00garbage")
+        (remote / "meta" / ".tmp-dead").write_bytes(b"\x00garbage")
+        ops_dirs = list((remote / "ops").iterdir())
+        (ops_dirs[0] / ".tmp-dead").write_bytes(b"\x00garbage")
+
+        c = await Core.open(make_opts(fs_factory(), orset_adapter()))
+        await c.read_remote()
+        assert c.with_state(lambda s: s.members()) == [b"a", b"c"]
+        await c.compact()  # GC also tolerates the junk
+        c2 = await Core.open(make_opts(fs_factory(), orset_adapter()))
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.members()) == [b"a", b"c"]
+
+    run(go())
+
+
+def test_interrupted_compact_is_idempotent_under_retry(fs_factory):
+    """Run compact repeatedly with a crash at a different point each time;
+    the remote must remain convergent throughout and end clean."""
+
+    async def go():
+        await _seed_orset(fs_factory)
+        for point, when in [
+            ("store_state", "before"),
+            ("store_state", "after"),
+            ("remove_states", "before"),
+            ("remove_ops", "before"),
+        ]:
+            crashy = CrashStorage(fs_factory(), point, when=when)
+            c = await Core.open(make_opts(crashy, orset_adapter()))
+            with pytest.raises(SimulatedCrash):
+                await c.compact()
+            probe = await Core.open(make_opts(fs_factory(), orset_adapter()))
+            await probe.read_remote()
+            assert probe.with_state(lambda s: s.members()) == [b"a", b"c"]
+
+        final = await Core.open(make_opts(fs_factory(), orset_adapter()))
+        await final.compact()
+        clean = fs_factory()
+        assert len(await clean.list_state_names()) == 1
+        assert await clean.list_op_actors() == []
+
+    run(go())

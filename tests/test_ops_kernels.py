@@ -332,6 +332,26 @@ def test_lww_fold_large_timestamps():
     assert device.get(b"k") == 2  # ts tie at base+9 → higher actor wins
 
 
+def test_lww_fold_into_equals_fold_of_whole():
+    # fold(A ++ B) == fold_into(fold(A), B): the incremental fold is exact
+    rng = np.random.default_rng(11)
+    Kn, n = 8, 64
+    key = rng.integers(0, Kn, n).astype(np.int32)
+    ts_hi = rng.integers(0, 4, n).astype(np.int32)
+    ts_lo = rng.integers(0, 100, n).astype(np.int32)
+    actor = rng.integers(0, 5, n).astype(np.int32)
+    value = rng.integers(0, 20, n).astype(np.int32)
+
+    whole = K.lww_fold(key, ts_hi, ts_lo, actor, value, num_keys=Kn)
+    h = n // 2
+    first = K.lww_fold(key[:h], ts_hi[:h], ts_lo[:h], actor[:h], value[:h], num_keys=Kn)
+    second = K.lww_fold_into(
+        first, key[h:], ts_hi[h:], ts_lo[h:], actor[h:], value[h:], num_keys=Kn
+    )
+    for a, b in zip(whole, second):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 # ---- MVReg ---------------------------------------------------------------
 
 
