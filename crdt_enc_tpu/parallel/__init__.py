@@ -1,4 +1,10 @@
 from .accel import TpuAccelerator
+from .distributed import (
+    global_op_batch,
+    initialize,
+    make_multihost_mesh,
+    replicate,
+)
 from .mesh import (
     make_mesh,
     orset_fold_sharded,
@@ -8,8 +14,12 @@ from .mesh import (
 
 __all__ = [
     "TpuAccelerator",
+    "global_op_batch",
+    "initialize",
     "make_mesh",
+    "make_multihost_mesh",
     "orset_fold_sharded",
     "orset_merge_sharded",
     "pad_rows_for_mesh",
+    "replicate",
 ]

@@ -1,0 +1,131 @@
+"""Multi-host layer on the virtual 8-device CPU mesh.
+
+A real DCN cluster is not available in tests, so process boundaries are
+*faked* through ``local_count``: an 8-device "pod" treated as 4 hosts × 2
+chips must place hosts along ``dp`` (each host folds only its own rows;
+the single cross-host collective is the ``pmax`` of folded partial planes)
+and each host's chips along ``mp`` (member-sharded planes, no fold-time
+collectives — ICI in production).  The globally-sharded batch assembly
+runs the same downstream fold path a multi-process run takes
+(``make_array_from_process_local_data`` itself degrades to a sharded
+``device_put`` when process_count == 1).
+"""
+
+import uuid
+
+import jax
+import numpy as np
+
+from crdt_enc_tpu import ops as K
+from crdt_enc_tpu.models import ORSet, canonical_bytes
+from crdt_enc_tpu.models.orset import AddOp, RmOp
+from crdt_enc_tpu.models.vclock import Dot, VClock
+from crdt_enc_tpu.parallel import distributed, mesh as pmesh
+
+ACTORS = [uuid.UUID(int=i + 1).bytes for i in range(4)]
+
+
+def test_initialize_single_process_is_noop():
+    # no coordinator configured, backend already up → nothing to bootstrap
+    assert distributed.initialize() is False
+    # and calling it again stays safe
+    assert distributed.initialize() is False
+
+
+def test_multihost_mesh_places_hosts_on_dp():
+    devices = jax.devices()
+    assert len(devices) == 8
+    mesh = distributed.make_multihost_mesh(local_count=2)  # fake 4 hosts × 2
+    assert mesh.shape == {"dp": 4, "mp": 2}
+    arr = mesh.devices
+    # row i must be exactly host i's chips (process-ordered pairs): each
+    # host is one dp shard, so its locally-decoded rows never leave it
+    for host in range(4):
+        row = list(arr[host, :])
+        assert row == devices[2 * host : 2 * host + 2]
+
+
+def test_multihost_mesh_single_host_degrades_to_all_mp():
+    mesh = distributed.make_multihost_mesh()
+    assert mesh.shape == {"dp": 1, "mp": 8}
+
+
+def _op_columns(n, R, E, seed=0):
+    rng = np.random.default_rng(seed)
+    kind = (rng.random(n) < 0.2).astype(np.int8)
+    member = rng.integers(0, E, n, dtype=np.int32)
+    actor = rng.integers(0, R, n, dtype=np.int32)
+    counter = np.zeros(n, np.int32)
+    seen = np.zeros(R, np.int32)
+    for i in range(n):
+        a = actor[i]
+        if kind[i] == 0:
+            seen[a] += 1
+            counter[i] = seen[a]
+        else:
+            if seen[a] == 0:
+                actor[i] = R  # nothing to remove → pad row
+            counter[i] = seen[a]
+    return kind, member, actor, counter
+
+
+def _host_fold(kind, member, actor, counter, R):
+    state = ORSet()
+    for k, m, a, c in zip(kind, member, actor, counter):
+        if a >= R:
+            continue
+        if k == 0:
+            state.apply(AddOp(int(m), Dot(ACTORS[a], int(c))))
+        else:
+            state.apply(RmOp(int(m), VClock({ACTORS[a]: int(c)})))
+    return state
+
+
+def test_global_batch_fold_on_multihost_mesh_matches_host():
+    """End to end: sharded batch assembly → sharded fold over a fake
+    4-host mesh → byte-identical state vs the per-op host loop."""
+    R, E = 4, 8
+    n = 93  # deliberately not a multiple of dp → exercises sentinel padding
+    kind, member, actor, counter = _op_columns(n, R, E, seed=3)
+    host = _host_fold(kind, member, actor, counter, R)
+
+    mesh = distributed.make_multihost_mesh(local_count=2)  # dp=4, mp=2
+    batch = distributed.global_op_batch(
+        mesh, kind, member, actor, counter, num_replicas=R
+    )
+    assert len(batch[0]) % mesh.shape["dp"] == 0
+    clock0, add0, rm0 = distributed.replicate(
+        mesh, np.zeros(R, np.int32), np.zeros((E, R), np.int32),
+        np.zeros((E, R), np.int32),
+    )
+    clock, add, rm = pmesh.orset_fold_sharded(mesh, clock0, add0, rm0, *batch)
+
+    members = K.Vocab(range(E))
+    replicas = K.Vocab(ACTORS)
+    folded = K.orset_planes_to_state(
+        np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+    )
+    assert canonical_bytes(folded) == canonical_bytes(host)
+
+
+def test_global_batch_respects_explicit_rows_per_host():
+    """rows_per_host (the cross-host row bucket) pads above the minimum —
+    extra rows must be inert sentinels."""
+    R, E = 4, 8
+    kind, member, actor, counter = _op_columns(40, R, E, seed=9)
+    host = _host_fold(kind, member, actor, counter, R)
+    mesh = distributed.make_multihost_mesh(local_count=2)
+    batch = distributed.global_op_batch(
+        mesh, kind, member, actor, counter, num_replicas=R, rows_per_host=64
+    )
+    assert len(batch[0]) == 64 * mesh.shape["dp"]  # one bucket per dp shard
+    clock0, add0, rm0 = distributed.replicate(
+        mesh, np.zeros(R, np.int32), np.zeros((E, R), np.int32),
+        np.zeros((E, R), np.int32),
+    )
+    clock, add, rm = pmesh.orset_fold_sharded(mesh, clock0, add0, rm0, *batch)
+    folded = K.orset_planes_to_state(
+        np.asarray(clock), np.asarray(add), np.asarray(rm),
+        K.Vocab(range(E)), K.Vocab(ACTORS),
+    )
+    assert canonical_bytes(folded) == canonical_bytes(host)
