@@ -180,10 +180,20 @@ class ORSet:
 
     # -- canonical serialization ------------------------------------------
     def to_obj(self):
+        """Canonical form.  The per-op apply path normalizes lazily (only
+        the touched member), so a remove horizon another member's adds have
+        retired (``≤ clock``) can linger in ``deferred`` — semantically
+        inert, but it would break byte equality against the batched folds,
+        which normalize globally.  Serialization is where canonical means
+        canonical: inert horizons are filtered here."""
+        dfr = {
+            m: {r: c for r, c in v.items() if c > self.clock.get(r)}
+            for m, v in self.deferred.items()
+        }
         return {
             b"c": self.clock.to_obj(),
             b"e": {m: dict(v) for m, v in self.entries.items() if v},
-            b"d": {m: dict(v) for m, v in self.deferred.items() if v},
+            b"d": {m: v for m, v in dfr.items() if v},
         }
 
     @classmethod

@@ -82,6 +82,24 @@ def load() -> ctypes.CDLL:
         ]
         lib.counter_decode.restype = ctypes.c_int64
 
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.orset_count_rows_batch.argtypes = [
+            u8p, u64p, u64p, ctypes.c_uint64, i64p
+        ]
+        lib.orset_count_rows_batch.restype = ctypes.c_int64
+        lib.orset_decode_batch.argtypes = [
+            u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64, i64p,
+            ctypes.POINTER(ctypes.c_int8), u64p, u64p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.orset_decode_batch.restype = ctypes.c_int64
+        lib.counter_decode_batch.argtypes = [
+            u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int8),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.counter_decode_batch.restype = ctypes.c_int64
+
         _lib = lib
         return lib
 

@@ -22,6 +22,7 @@ from ..utils import codec
 
 _i8p = ctypes.POINTER(ctypes.c_int8)
 _i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
 
 
 def decode_orset_payload_batch(payloads: list, actors_sorted: list):
@@ -46,21 +47,19 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     actors_flat = b"".join(actors_sorted)
     ap, _a = native.in_ptr(actors_flat)
 
-    # pass 1: row counts (also validates framing)
-    bases = np.zeros(len(payloads) + 1, np.int64)
+    lens = np.array([len(p) for p in payloads], np.uint64)
+    bases = np.zeros(len(payloads), np.uint64)
+    np.cumsum(lens[:-1], out=bases[1:])
+    basep = bases.ctypes.data_as(native.u64p)
+    lenp = lens.ctypes.data_as(native.u64p)
+
+    # pass 1: row counts (also validates framing) — one native call
     counts = np.zeros(len(payloads), np.int64)
-    off = 0
-    for i, p in enumerate(payloads):
-        bases[i] = off
-        n = lib.orset_count_rows(
-            buf[off:].ctypes.data_as(native.u8p), len(p)
-        )
-        if n < 0:
-            return None
-        counts[i] = n
-        off += len(p)
-    bases[len(payloads)] = off
-    total = int(counts.sum())
+    total = lib.orset_count_rows_batch(
+        bp, basep, lenp, len(payloads), counts.ctypes.data_as(_i64p)
+    )
+    if total < 0:
+        return None
     if total == 0:
         return (
             np.zeros(0, np.int8),
@@ -76,27 +75,18 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     actor = np.zeros(total, np.int32)
     counter = np.zeros(total, np.int32)
 
-    # pass 2: decode each payload into its row slice
-    row = 0
-    for i, p in enumerate(payloads):
-        n = int(counts[i])
-        if n == 0:
-            continue
-        got = lib.orset_decode(
-            buf[int(bases[i]) :].ctypes.data_as(native.u8p),
-            len(p),
-            ap,
-            len(actors_sorted),
-            kind[row:].ctypes.data_as(_i8p),
-            moff[row:].ctypes.data_as(native.u64p),
-            mlen[row:].ctypes.data_as(native.u64p),
-            actor[row:].ctypes.data_as(_i32p),
-            counter[row:].ctypes.data_as(_i32p),
-        )
-        if got != n:
-            return None
-        moff[row : row + n] += np.uint64(bases[i])
-        row += n
+    # pass 2: decode everything into consecutive row slices — one call
+    got = lib.orset_decode_batch(
+        bp, basep, lenp, len(payloads), ap, len(actors_sorted),
+        counts.ctypes.data_as(_i64p),
+        kind.ctypes.data_as(_i8p),
+        moff.ctypes.data_as(native.u64p),
+        mlen.ctypes.data_as(native.u64p),
+        actor.ctypes.data_as(_i32p),
+        counter.ctypes.data_as(_i32p),
+    )
+    if got != total:
+        return None
 
     member_idx, members = intern_spans(buf, moff, mlen)
     return kind, member_idx, actor, counter, members
@@ -139,32 +129,27 @@ def decode_counter_payload_batch(payloads: list, actors_sorted: list):
     actors_flat = b"".join(actors_sorted)
     ap, _a = native.in_ptr(actors_flat)
 
-    signs, actors, counters = [], [], []
-    off = 0
-    for p in payloads:
-        # counter payloads are op arrays: rows == top-level array length,
-        # obtained by decoding directly (counter_decode validates fully)
-        cap = max(len(p), 1)  # rows ≤ payload bytes
-        sign = np.zeros(cap, np.int8)
-        actor = np.zeros(cap, np.int32)
-        counter = np.zeros(cap, np.int32)
-        got = lib.counter_decode(
-            buf[off:].ctypes.data_as(native.u8p),
-            len(p),
-            ap,
-            len(actors_sorted),
-            sign.ctypes.data_as(_i8p),
-            actor.ctypes.data_as(_i32p),
-            counter.ctypes.data_as(_i32p),
-        )
-        if got < 0:
-            return None
-        signs.append(sign[:got])
-        actors.append(actor[:got])
-        counters.append(counter[:got])
-        off += len(p)
-    return (
-        np.concatenate(signs),
-        np.concatenate(actors),
-        np.concatenate(counters),
+    lens = np.array([len(p) for p in payloads], np.uint64)
+    bases = np.zeros(len(payloads), np.uint64)
+    np.cumsum(lens[:-1], out=bases[1:])
+
+    # one native call; every op costs >1 encoded byte, so total payload
+    # bytes bounds the row count
+    cap = max(len(big), 1)
+    sign = np.zeros(cap, np.int8)
+    actor = np.zeros(cap, np.int32)
+    counter = np.zeros(cap, np.int32)
+    got = lib.counter_decode_batch(
+        buf.ctypes.data_as(native.u8p),
+        bases.ctypes.data_as(native.u64p),
+        lens.ctypes.data_as(native.u64p),
+        len(payloads),
+        ap,
+        len(actors_sorted),
+        sign.ctypes.data_as(_i8p),
+        actor.ctypes.data_as(_i32p),
+        counter.ctypes.data_as(_i32p),
     )
+    if got < 0:
+        return None
+    return sign[:got], actor[:got], counter[:got]

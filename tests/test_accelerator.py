@@ -112,3 +112,80 @@ def test_merge_many_orsets_matches_host():
         copy.deepcopy(replicas[0]), [copy.deepcopy(s) for s in replicas[1:]]
     )
     assert canonical_bytes(t) == canonical_bytes(h)
+
+
+# ---- sparse (sorted-COO) ORSet fold path ---------------------------------
+
+
+def sparse_accel():
+    """Force the sparse fold for any vocab: thresholds dropped to zero."""
+    a = TpuAccelerator(min_device_batch=1)
+    a.SPARSE_MIN_CELLS = 0
+    a.SPARSE_CELLS_PER_ROW = 0
+    return a
+
+
+def _orset_script(n_ops=400, n_members=30, seed=5, actors=ACTORS):
+    """A host-applied op history with interleaved adds/removes."""
+    rng = np.random.default_rng(seed)
+    state = ORSet()
+    ops = []
+    for i in range(n_ops):
+        a = actors[int(rng.integers(len(actors)))]
+        m = int(rng.integers(n_members))
+        if rng.random() < 0.25:
+            op = state.rm_ctx(m)
+            if op.ctx.is_empty():
+                continue
+        else:
+            op = state.add_ctx(a, m)
+        state.apply(op)
+        ops.append(op)
+    return state, ops
+
+
+def test_sparse_orset_fold_matches_host_and_dense():
+    final, ops = _orset_script()
+    h = HostAccelerator().fold_ops(ORSet(), list(ops))
+    dense = accel().fold_ops(ORSet(), list(ops))
+    sparse = sparse_accel().fold_ops(ORSet(), list(ops))
+    assert canonical_bytes(sparse) == canonical_bytes(h)
+    assert canonical_bytes(sparse) == canonical_bytes(dense)
+    assert canonical_bytes(sparse) == canonical_bytes(final)
+
+
+def test_sparse_orset_fold_into_existing_state():
+    # fold the second half of a history into the state built from the first
+    final, ops = _orset_script(seed=8)
+    half = len(ops) // 2
+    base_h = HostAccelerator().fold_ops(ORSet(), list(ops[:half]))
+    base_s = copy.deepcopy(base_h)
+    h = HostAccelerator().fold_ops(base_h, list(ops[half:]))
+    s = sparse_accel().fold_ops(base_s, list(ops[half:]))
+    assert canonical_bytes(s) == canonical_bytes(h)
+    assert canonical_bytes(s) == canonical_bytes(final)
+
+
+def test_sparse_orset_fold_clock_retires_foreign_deferred():
+    # a remove-ahead horizon parks in deferred; a later add batch advances
+    # the clock past it — the sparse path must retire it exactly like the
+    # host does, even though the batch never names that member
+    s_host = ORSet()
+    s_host.apply(ORSet().add_ctx(ACTORS[0], 1))  # dot (a0, 1) for member 1
+    from crdt_enc_tpu.models.orset import RmOp
+    from crdt_enc_tpu.models.vclock import VClock
+
+    rm_ahead = RmOp(2, VClock({ACTORS[1]: 3}))  # horizon beyond a1's clock
+    s_host.apply(rm_ahead)
+    s_sparse = copy.deepcopy(s_host)
+    assert 2 in s_host.deferred
+
+    # hand-build dots 1..3 for member 9 so a1's clock reaches the horizon
+    from crdt_enc_tpu.models.orset import AddOp
+    from crdt_enc_tpu.models.vclock import Dot
+
+    late_adds = [AddOp(9, Dot(ACTORS[1], c)) for c in (1, 2, 3)]
+    h = HostAccelerator().fold_ops(s_host, list(late_adds))
+    s = sparse_accel().fold_ops(s_sparse, list(late_adds))
+    assert canonical_bytes(s) == canonical_bytes(h)
+    assert 2 not in s.deferred  # horizon retired by the advanced clock
