@@ -179,6 +179,13 @@ class Core:
         self._data = _MutData(opts.adapter.new())
         self._apply_lock = asyncio.Lock()
         self._meta_lock = asyncio.Lock()
+        # Serializes every keys read-copy-write against remote-meta
+        # ingestion: the key cryptor's register write happens AFTER its
+        # (possibly slow, e.g. scrypt) protect step, so without exclusion a
+        # Keys value merged during that await would be causally superseded
+        # by a write built from a stale snapshot — losing key material.
+        # Lock order: _keys_lock → _meta_lock (never the reverse).
+        self._keys_lock = asyncio.Lock()
         self._local_meta: LocalMeta | None = None
 
     # ------------------------------------------------------------------ open
@@ -214,10 +221,7 @@ class Core:
 
         # bootstrap the first data key if key management has none yet
         if core._data.keys.latest_key() is None:
-            material = await core.cryptor.gen_key()
-            keys = Keys.from_obj(core._data.keys.to_obj())
-            keys.insert_latest_key(core.actor_id, Key.new(material))
-            await core.key_cryptor.set_keys(keys)
+            await core._install_new_key()
             if core._data.keys.latest_key() is None:
                 raise MissingKeyError(
                     "key cryptor did not install a latest key at open"
@@ -246,6 +250,35 @@ class Core:
         if asyncio.iscoroutinefunction(fn):
             raise TypeError("with_state callbacks must be synchronous (LockBox)")
         return fn(self._data.state)
+
+    # ----------------------------------------------------------- key rotation
+    async def _install_new_key(self) -> Key:
+        """Generate a key, add it to the Keys CRDT as the new latest, and
+        push through the key cryptor — the snapshot→write cycle runs under
+        ``_keys_lock`` so concurrent meta ingestion cannot be superseded
+        by a stale snapshot."""
+        async with self._keys_lock:
+            material = await self.cryptor.gen_key()
+            keys = Keys.from_obj(self._data.keys.to_obj())
+            key = Key.new(material)
+            keys.insert_latest_key(self.actor_id, key)
+            await self.key_cryptor.set_keys(keys)
+        if self._data.keys.get_key(key.id) is None:
+            raise MissingKeyError("key cryptor did not install the new key")
+        return key
+
+    async def rotate_key(self) -> Key:
+        """Generate and install a fresh data key as the new latest.
+
+        The LUKS property the layered design exists for (reference
+        README.md:19-25): rotation never re-encrypts data.  Blobs written
+        before the rotation stay readable because every blob's outer layer
+        records its sealing key id (see ``_seal``) and old keys remain in
+        the Keys CRDT; everything written after seals with the new key.
+        Converges to other replicas through the remote metadata like any
+        key change.  Returns the new key.
+        """
+        return await self._install_new_key()
 
     # ------------------------------------------------------- wire (3 layers)
     def _latest_key(self) -> Key:
@@ -539,14 +572,20 @@ class Core:
         names = await self.storage.list_remote_meta_names()
         new = [n for n in names if n not in self._data.read_metas]
         loaded = await self.storage.load_remote_metas(new) if new else []
-        for name, raw in loaded:
-            vb = VersionBytes.deserialize(raw).ensure_versions(
-                SUPPORTED_CONTAINER_VERSIONS
-            )
-            self._data.remote_meta.merge(RemoteMeta.from_obj(codec.unpack(vb.content)))
-            self._data.read_metas.add(name)
-        if loaded or force_notify:
-            await self._notify_plugins()
+        # merge + plugin fan-out under the keys lock: a key-register merge
+        # landing inside _install_new_key's snapshot→write window would be
+        # silently superseded (lock order: _keys_lock → _meta_lock)
+        async with self._keys_lock:
+            for name, raw in loaded:
+                vb = VersionBytes.deserialize(raw).ensure_versions(
+                    SUPPORTED_CONTAINER_VERSIONS
+                )
+                self._data.remote_meta.merge(
+                    RemoteMeta.from_obj(codec.unpack(vb.content))
+                )
+                self._data.read_metas.add(name)
+            if loaded or force_notify:
+                await self._notify_plugins()
 
     async def _notify_plugins(self) -> None:
         """Fan each plugin its (copied) config register (lib.rs:596-609)."""

@@ -1,0 +1,99 @@
+"""Encrypted shared todo list — an OR-Set over a synced directory.
+
+A fuller tour than counter_sync.py: observed-remove set semantics (add wins
+over a concurrent remove of an *older* observation), key rotation without
+re-encryption (``rotate_key``), and compaction folding the op log into one
+sealed snapshot.  Every replica is a device pointing at the same ``remote``
+directory (in production, synced by an external tool — the replication
+model of the reference, README.md:3-11).
+
+    python examples/todo_orset.py --data ./todo --local laptop add "buy milk"
+    python examples/todo_orset.py --data ./todo --local phone  list
+    python examples/todo_orset.py --data ./todo --local phone  done "buy milk"
+    python examples/todo_orset.py --data ./todo --local laptop rotate-key
+    python examples/todo_orset.py --data ./todo --local laptop compact
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from crdt_enc_tpu.backends import FsStorage, PassphraseKeyCryptor, XChaChaCryptor
+from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+async def open_replica(data_dir: str, local: str, passphrase: str) -> Core:
+    root = Path(data_dir)
+    core = await Core.open(
+        OpenOptions(
+            storage=FsStorage(str(root / local), str(root / "remote")),
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PassphraseKeyCryptor(passphrase),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        )
+    )
+    await core.read_remote()
+    return core
+
+
+async def run(args) -> None:
+    core = await open_replica(args.data, args.local, args.passphrase)
+    if args.cmd == "add":
+        item = args.item.encode()
+        await core.update(lambda s: s.add_ctx(core.actor_id, item))
+        print(f"[{args.local}] added {args.item!r}")
+    elif args.cmd == "done":
+        item = args.item.encode()
+        # rm_ctx removes the observed add-dots; an add this replica has
+        # not yet seen survives (observed-remove semantics)
+        op = core.with_state(lambda s: s.rm_ctx(item))
+        if op.ctx.is_empty():
+            print(f"[{args.local}] {args.item!r} not in the list here")
+        else:
+            await core.apply_ops([op])
+            print(f"[{args.local}] done {args.item!r}")
+    elif args.cmd == "list":
+        items = core.with_state(lambda s: s.members())
+        print(f"[{args.local}] {len(items)} open item(s):")
+        for m in items:
+            print(f"  - {m.decode(errors='replace')}")
+    elif args.cmd == "rotate-key":
+        key = await core.rotate_key()
+        print(
+            f"[{args.local}] rotated data key; new writes seal with "
+            f"{key.id.hex()[:8]}…, old files stay readable"
+        )
+    elif args.cmd == "compact":
+        await core.compact()
+        print(
+            f"[{args.local}] compacted: op log folded into one sealed snapshot"
+        )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data", default="./todo")
+    ap.add_argument("--local", default="dev-a")
+    ap.add_argument("--passphrase", default="example-passphrase")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list")
+    p = sub.add_parser("add")
+    p.add_argument("item")
+    p = sub.add_parser("done")
+    p.add_argument("item")
+    sub.add_parser("rotate-key")
+    sub.add_parser("compact")
+    asyncio.run(run(ap.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -204,3 +204,102 @@ def test_concurrent_writers_serialized(storage_factory):
         assert c2.with_state(lambda s: s.read()) == 15
 
     run(go())
+
+
+def test_key_rotation_old_data_stays_readable(storage_factory):
+    """rotate_key: new writes seal with the new key, old blobs stay
+    readable via their recorded key id, and the rotation converges to
+    replicas that join later (the LUKS property, README.md:19-25)."""
+
+    async def go():
+        c1 = await Core.open(make_opts(storage_factory(), gcounter_adapter()))
+        await c1.update(lambda s: s.inc(c1.actor_id, 3))
+        old = c1._data.keys.latest_key()
+
+        new = await c1.rotate_key()
+        assert new.id != old.id
+        assert c1._data.keys.latest_key().id == new.id
+        # the superseded key remains resolvable for old blobs
+        assert c1._data.keys.get_key(old.id) is not None
+
+        await c1.update(lambda s: s.inc(c1.actor_id, 4))  # sealed w/ new key
+
+        # a replica joining after the rotation reads both generations
+        c2 = await Core.open(make_opts(storage_factory(), gcounter_adapter()))
+        assert c2._data.keys.latest_key().id == new.id
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.read()) == 7
+
+        # compaction re-seals everything under the latest key
+        await c2.compact()
+        c3 = await Core.open(make_opts(storage_factory(), gcounter_adapter()))
+        await c3.read_remote()
+        assert c3.with_state(lambda s: s.read()) == 7
+
+    run(go())
+
+
+def test_rotation_race_min_id_tie_break(storage_factory):
+    """Two replicas rotate concurrently: both keys land in the CRDT and
+    every replica deterministically agrees on the same latest
+    (min-id tie-break, reference key_cryptor.rs:59-70)."""
+
+    async def go():
+        c1 = await Core.open(make_opts(storage_factory(), gcounter_adapter()))
+        c2 = await Core.open(make_opts(storage_factory(), gcounter_adapter()))
+        # both rotate without seeing each other's rotation
+        k1 = await c1.rotate_key()
+        k2 = await c2.rotate_key()
+        await c1.read_remote()
+        await c2.read_remote()
+        expect = min(k1.id, k2.id)
+        assert c1._data.keys.latest_key().id == expect
+        assert c2._data.keys.latest_key().id == expect
+        # writes from both sides remain mutually readable
+        await c1.update(lambda s: s.inc(c1.actor_id, 1))
+        await c2.update(lambda s: s.inc(c2.actor_id, 2))
+        await c1.read_remote()
+        await c2.read_remote()
+        assert c1.with_state(lambda s: s.read()) == 3
+        assert c2.with_state(lambda s: s.read()) == 3
+
+    run(go())
+
+
+def test_rotation_vs_meta_ingestion_race_keeps_all_keys(storage_factory):
+    """Regression: rotate_key's snapshot→register-write cycle suspends in
+    the key cryptor's protect step (scrypt takes ~50ms); a remote Keys
+    value merged during that window must NOT be causally superseded by
+    the stale snapshot — that would permanently drop its key material and
+    orphan every blob it sealed.  The _keys_lock serializes the two."""
+    import asyncio as aio
+
+    from crdt_enc_tpu.backends.plain_keys import PlainKeyCryptor
+
+    class SlowKeyCryptor(PlainKeyCryptor):
+        async def _protect(self, raw):
+            await aio.sleep(0.05)  # model the scrypt window
+            return raw
+
+    async def go():
+        c1 = await Core.open(make_opts(storage_factory(), gcounter_adapter()))
+        # B opens BEFORE A's rotation, so B's key snapshot can't contain kA
+        opts_b = make_opts(storage_factory(), gcounter_adapter())
+        opts_b.key_cryptor = SlowKeyCryptor()
+        c2 = await Core.open(opts_b)
+
+        await c1.update(lambda s: s.inc(c1.actor_id, 1))
+        kA = await c1.rotate_key()
+        await c1.update(lambda s: s.inc(c1.actor_id, 2))  # sealed with kA
+
+        # the race: B rotates (slow protect) while ingesting A's metadata
+        await aio.gather(c2.rotate_key(), c2.read_remote())
+        await c2.read_remote()
+        assert c2._data.keys.get_key(kA.id) is not None, "kA material lost"
+        assert c2.with_state(lambda s: s.read()) == 3  # kA blobs readable
+
+        # and A still converges with B's rotation in the mix
+        await c1.read_remote()
+        assert c1._data.keys.get_key(kA.id) is not None
+
+    run(go())

@@ -6,13 +6,13 @@ import importlib.util
 import sys
 from pathlib import Path
 
-EXAMPLE = Path(__file__).resolve().parent.parent / "examples" / "counter_sync.py"
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
 
 
-def load_example():
-    spec = importlib.util.spec_from_file_location("counter_sync", EXAMPLE)
+def load_example(name="counter_sync"):
+    spec = importlib.util.spec_from_file_location(name, EXAMPLES / f"{name}.py")
     mod = importlib.util.module_from_spec(spec)
-    sys.modules["counter_sync"] = mod
+    sys.modules[name] = mod
     spec.loader.exec_module(mod)
     return mod
 
@@ -30,3 +30,30 @@ def test_example_two_replicas_climb(tmp_path):
 
     v1, v2, v3 = asyncio.run(go())
     assert (v1, v2, v3) == (1, 2, 3)
+
+
+def test_todo_example_flow(tmp_path):
+    """The todo example's full command surface: add/done/list across
+    replicas, key rotation mid-stream, compaction, fresh-replica read."""
+    ex = load_example("todo_orset")
+    import argparse
+
+    def ns(local, cmd, item=None):
+        return argparse.Namespace(
+            data=str(tmp_path), local=local, passphrase="pw",
+            cmd=cmd, item=item,
+        )
+
+    async def go():
+        await ex.run(ns("laptop", "add", "buy milk"))
+        await ex.run(ns("laptop", "add", "fix roof"))
+        await ex.run(ns("phone", "done", "buy milk"))
+        await ex.run(ns("laptop", "rotate-key"))
+        await ex.run(ns("laptop", "add", "call mom"))
+        await ex.run(ns("laptop", "compact"))
+        # a brand-new replica reads only the compacted, re-sealed remote
+        tablet = await ex.open_replica(str(tmp_path), "tablet", "pw")
+        return tablet.with_state(lambda s: set(s.members()))
+
+    items = asyncio.run(go())
+    assert items == {b"fix roof", b"call mom"}
