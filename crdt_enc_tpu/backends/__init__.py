@@ -5,15 +5,35 @@ from .passphrase_keys import PassphraseKeyCryptor, WrongPassphrase
 from .plain_keys import PlainKeyCryptor
 from .xchacha import AeadError, XChaChaCryptor
 
+# The X25519 backend needs the third-party `cryptography` package; load it
+# lazily (PEP 562) so environments without it keep every other backend.
+_X25519_NAMES = ("NotARecipient", "X25519KeyCryptor", "generate_keypair")
+
+
+def __getattr__(name):
+    if name in _X25519_NAMES:
+        from . import x25519_keys
+
+        return getattr(x25519_keys, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_X25519_NAMES))
+
+
 __all__ = [
     "AeadError",
     "FsStorage",
     "IdentityCryptor",
     "MemoryRemote",
     "MemoryStorage",
+    "NotARecipient",
     "PassphraseKeyCryptor",
     "PlainKeyCryptor",
     "WrongPassphrase",
+    "X25519KeyCryptor",
     "XChaChaCryptor",
     "content_name",
+    "generate_keypair",
 ]

@@ -1,0 +1,202 @@
+"""Recipient-keyed (asymmetric) key-cryptor backend.
+
+The real version of what the reference's gpgme backend intended and left as
+a stub (crdt-enc-gpgme/src/lib.rs:131-175: the PGP encrypt-to-recipients
+calls are commented out; its unused ``Meta`` CRDT at lib.rs:51-66 was a set
+of recipient fingerprints): the serialized Keys CRDT is sealed *to a set of
+recipient public keys*, so replicas never share a secret out of band — each
+holds its own X25519 private key, and adding a device means adding its
+public key to the recipient set, not re-encrypting any data.
+
+Wrap format (content under ``X25519_KEYS_META_VERSION_1``):
+
+    msgpack([eph_pub, sealed, {recipient_pub: nonce ‖ wrapped_blob_key}])
+
+One random 32-byte blob key seals the Keys blob through the native
+XChaCha20-Poly1305 envelope (same bytes as the data path); for each
+recipient the blob key is wrapped under ChaCha20-Poly1305 with a key from
+``HKDF-SHA256(X25519(eph_priv, recipient_pub), info = tag ‖ eph_pub ‖
+recipient_pub)``.  The ephemeral keypair is fresh per write, so two
+replicas writing the same Keys produce distinct blobs — convergence
+happens at the CRDT layer after unwrap, like the other key backends.
+
+The recipient set itself converges grow-only: the wrap map is keyed by the
+full recipient public keys (they are public), and every blob a replica
+successfully opens unions its recipients into the local roster — so a
+replica restarted with a stale roster cannot silently lock peers out of
+future key material (this realizes the converged recipient-set ``Meta``
+CRDT the reference's gpgme backend declared but never used,
+crdt-enc-gpgme/src/lib.rs:51-66).  Deliberate revocation opts out with
+``pin_recipients=True`` + a key rotation.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from ..utils import codec
+from ..utils.versions import (
+    SUPPORTED_X25519_KEYS_META_VERSIONS,
+    X25519_KEYS_META_VERSION_1,
+)
+from . import xchacha
+from .plain_keys import PlainKeyCryptor
+
+_HKDF_TAG = b"crdt-enc-tpu x25519 keys v1"
+PUB_LEN = 32
+_NONCE_LEN = 12
+
+
+class NotARecipient(Exception):
+    """This replica's public key is not in the blob's recipient set (or the
+    blob is malformed / fails authentication)."""
+
+
+def generate_keypair() -> tuple[bytes, bytes]:
+    """A fresh (private, public) raw-byte X25519 pair."""
+    priv = X25519PrivateKey.generate()
+    return (
+        priv.private_bytes_raw(),
+        priv.public_key().public_bytes_raw(),
+    )
+
+
+def _kek(shared: bytes, eph_pub: bytes, recipient_pub: bytes) -> bytes:
+    return HKDF(
+        algorithm=hashes.SHA256(),
+        length=32,
+        salt=None,
+        info=_HKDF_TAG + eph_pub + recipient_pub,
+    ).derive(shared)
+
+
+def wrap_blob(raw: bytes, recipients: list[bytes]) -> bytes:
+    """Seal ``raw`` to every recipient public key."""
+    if not recipients:
+        raise ValueError("at least one recipient public key required")
+    blob_key = secrets.token_bytes(xchacha.KEY_LEN)
+    sealed = xchacha.encrypt_blob(blob_key, raw)
+    eph = X25519PrivateKey.generate()
+    eph_pub = eph.public_key().public_bytes_raw()
+    wraps = {}
+    for pub in recipients:
+        pub = bytes(pub)
+        if len(pub) != PUB_LEN:
+            raise ValueError(f"recipient public key must be {PUB_LEN} bytes")
+        shared = eph.exchange(X25519PublicKey.from_public_bytes(pub))
+        nonce = secrets.token_bytes(_NONCE_LEN)
+        wrapped = ChaCha20Poly1305(_kek(shared, eph_pub, pub)).encrypt(
+            nonce, blob_key, b""
+        )
+        wraps[pub] = nonce + wrapped
+    return codec.pack([eph_pub, sealed, wraps])
+
+
+def unwrap_blob(private_key: bytes, blob: bytes) -> tuple[bytes, list[bytes]]:
+    """Open a sealed Keys blob with this replica's private key.
+
+    Returns ``(cleartext, recipients)`` — the blob's recipient public keys,
+    so callers can converge their roster."""
+    priv = X25519PrivateKey.from_private_bytes(private_key)
+    my_pub = priv.public_key().public_bytes_raw()
+    try:
+        eph_pub, sealed, wraps = codec.unpack(blob)
+        if not isinstance(eph_pub, (bytes, bytearray)) or not isinstance(
+            sealed, (bytes, bytearray)
+        ):
+            raise TypeError("eph_pub/sealed must be binary")
+        eph_pub, sealed = bytes(eph_pub), bytes(sealed)
+        if len(eph_pub) != PUB_LEN:
+            raise ValueError("bad ephemeral public key length")
+        recipients = [bytes(p) for p in wraps]
+        if any(len(p) != PUB_LEN for p in recipients):
+            raise ValueError("bad recipient public key length")
+        entry = wraps.get(my_pub)
+    except NotARecipient:
+        raise
+    except Exception as e:
+        raise NotARecipient(f"malformed recipient wrap: {e}") from e
+    if entry is None:
+        raise NotARecipient(
+            "this replica's key is not in the blob's recipient set"
+        )
+    entry = bytes(entry)
+    if len(entry) < _NONCE_LEN + 16:
+        raise NotARecipient("recipient wrap entry too short")
+    shared = priv.exchange(X25519PublicKey.from_public_bytes(eph_pub))
+    try:
+        blob_key = ChaCha20Poly1305(_kek(shared, eph_pub, my_pub)).decrypt(
+            entry[:_NONCE_LEN], entry[_NONCE_LEN:], b""
+        )
+        return xchacha.decrypt_blob(blob_key, sealed), recipients
+    except (InvalidTag, xchacha.AeadError) as e:
+        raise NotARecipient(f"authentication failed: {e}") from e
+
+
+class X25519KeyCryptor(PlainKeyCryptor):
+    """Key management sealed to recipient public keys (no shared secret).
+
+    ``private_key`` is this replica's raw 32-byte X25519 private key
+    (``generate_keypair()``); ``recipients`` are the public keys allowed to
+    read the key material — this replica's own public key is included
+    automatically, so a lone replica needs no recipient list at all.
+
+    The roster converges grow-only by default: recipients of every blob
+    this replica successfully opens are unioned in, so a device restarted
+    with a stale config cannot seal future key material away from peers an
+    earlier writer admitted.  ``pin_recipients=True`` disables the union
+    for deliberate revocation (follow with ``core.rotate_key()`` so a new
+    key exists that the revoked device never receives; it keeps the old
+    keys it already saw).
+    """
+
+    META_VERSION = X25519_KEYS_META_VERSION_1
+    SUPPORTED_META_VERSIONS = SUPPORTED_X25519_KEYS_META_VERSIONS
+
+    def __init__(
+        self,
+        private_key: bytes,
+        recipients: list[bytes] = (),
+        *,
+        pin_recipients: bool = False,
+    ):
+        super().__init__()
+        self._priv = bytes(private_key)
+        my_pub = X25519PrivateKey.from_private_bytes(
+            self._priv
+        ).public_key().public_bytes_raw()
+        pubs = [bytes(p) for p in recipients]
+        if my_pub not in pubs:
+            pubs.append(my_pub)
+        self._recipients = pubs
+        self._pinned = pin_recipients
+
+    @property
+    def public_key(self) -> bytes:
+        return X25519PrivateKey.from_private_bytes(
+            self._priv
+        ).public_key().public_bytes_raw()
+
+    @property
+    def recipients(self) -> tuple[bytes, ...]:
+        return tuple(self._recipients)
+
+    async def _protect(self, raw: bytes) -> bytes:
+        return wrap_blob(raw, self._recipients)
+
+    async def _unprotect(self, vb) -> bytes:
+        clear, seen = unwrap_blob(self._priv, vb.content)
+        if not self._pinned:
+            for pub in seen:
+                if pub not in self._recipients:
+                    self._recipients.append(pub)
+        return clear

@@ -572,9 +572,13 @@ class Core:
         names = await self.storage.list_remote_meta_names()
         new = [n for n in names if n not in self._data.read_metas]
         loaded = await self.storage.load_remote_metas(new) if new else []
-        # merge + plugin fan-out under the keys lock: a key-register merge
-        # landing inside _install_new_key's snapshot→write window would be
-        # silently superseded (lock order: _keys_lock → _meta_lock)
+        # The merge and the KEY-cryptor fan-out hold the keys lock: a
+        # key-register merge landing inside _install_new_key's
+        # snapshot→write window would be silently superseded (lock order:
+        # _keys_lock → _meta_lock).  The storage/cryptor notifications
+        # don't touch the keys register, so they run outside the lock —
+        # rotation never waits on their (possibly fsync-heavy) callbacks.
+        storage_reg = cryptor_reg = None
         async with self._keys_lock:
             for name, raw in loaded:
                 vb = VersionBytes.deserialize(raw).ensure_versions(
@@ -585,16 +589,17 @@ class Core:
                 )
                 self._data.read_metas.add(name)
             if loaded or force_notify:
-                await self._notify_plugins()
-
-    async def _notify_plugins(self) -> None:
-        """Fan each plugin its (copied) config register (lib.rs:596-609)."""
-        rm = self._data.remote_meta
-        await asyncio.gather(
-            self.storage.set_remote_meta(MVReg.from_obj(rm.storage.to_obj())),
-            self.cryptor.set_remote_meta(MVReg.from_obj(rm.cryptor.to_obj())),
-            self.key_cryptor.set_remote_meta(MVReg.from_obj(rm.key_cryptor.to_obj())),
-        )
+                rm = self._data.remote_meta
+                storage_reg = MVReg.from_obj(rm.storage.to_obj())
+                cryptor_reg = MVReg.from_obj(rm.cryptor.to_obj())
+                await self.key_cryptor.set_remote_meta(
+                    MVReg.from_obj(rm.key_cryptor.to_obj())
+                )
+        if storage_reg is not None:
+            await asyncio.gather(
+                self.storage.set_remote_meta(storage_reg),
+                self.cryptor.set_remote_meta(cryptor_reg),
+            )
 
     async def _store_remote_meta(self) -> None:
         """Persist converged metadata: content-addressed write, then remove

@@ -40,6 +40,13 @@ SUPPORTED_PASSPHRASE_KEYS_META_VERSIONS = frozenset(
     {PASSPHRASE_KEYS_META_VERSION_1}
 )
 
+# Recipient-keyed (X25519) key-cryptor remote-meta format: the Keys blob
+# sealed to a set of recipient public keys (ephemeral ECDH + HKDF + AEAD).
+X25519_KEYS_META_VERSION_1 = uuid.UUID(
+    "4fb7a9d2-3c16-4e80-9b5a-217f60d8e3c9"
+).bytes
+SUPPORTED_X25519_KEYS_META_VERSIONS = frozenset({X25519_KEYS_META_VERSION_1})
+
 # Application-data versions are *not* fixed here: like the reference's
 # OpenOptions.supported_data_versions (lib.rs:730-731) they are chosen by the
 # application that owns the CRDT state type.  A reasonable default for tests:

@@ -1,0 +1,180 @@
+"""Recipient-keyed (X25519) key cryptor: the asymmetric backend the
+reference's gpgme plugin stubbed out (its PGP calls are commented out,
+crdt-enc-gpgme/src/lib.rs:131-175).  No shared secret: each replica holds a
+private key; readability is membership in the recipient set."""
+
+import asyncio
+
+import pytest
+
+from crdt_enc_tpu.backends import (
+    FsStorage,
+    IdentityCryptor,
+    NotARecipient,
+    X25519KeyCryptor,
+    XChaChaCryptor,
+    generate_keypair,
+)
+from crdt_enc_tpu.backends.x25519_keys import unwrap_blob, wrap_blob
+from crdt_enc_tpu.core import Core, CoreError, OpenOptions, orset_adapter
+from crdt_enc_tpu.models import canonical_bytes
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- wrap/unwrap primitives ----------------------------------------------
+
+
+def test_wrap_unwrap_roundtrip_multi_recipient():
+    priv_a, pub_a = generate_keypair()
+    priv_b, pub_b = generate_keypair()
+    blob = wrap_blob(b"the keys crdt", [pub_a, pub_b])
+    clear_a, seen_a = unwrap_blob(priv_a, blob)
+    clear_b, seen_b = unwrap_blob(priv_b, blob)
+    assert clear_a == clear_b == b"the keys crdt"
+    # the blob carries its recipient set, enabling roster convergence
+    assert set(seen_a) == set(seen_b) == {pub_a, pub_b}
+
+
+def test_non_recipient_rejected():
+    _, pub_a = generate_keypair()
+    priv_eve, _ = generate_keypair()
+    blob = wrap_blob(b"secret", [pub_a])
+    with pytest.raises(NotARecipient):
+        unwrap_blob(priv_eve, blob)
+
+
+def test_tampered_blob_rejected():
+    priv_a, pub_a = generate_keypair()
+    blob = bytearray(wrap_blob(b"secret", [pub_a]))
+    blob[-1] ^= 0x01
+    with pytest.raises(NotARecipient):
+        unwrap_blob(priv_a, bytes(blob))
+
+
+def test_fresh_ephemeral_per_write():
+    priv_a, pub_a = generate_keypair()
+    assert wrap_blob(b"x", [pub_a]) != wrap_blob(b"x", [pub_a])
+
+
+# ---- through the core -----------------------------------------------------
+
+
+def make_opts(tmp_path, name, priv, recipients, create=True):
+    return OpenOptions(
+        storage=FsStorage(str(tmp_path / name), str(tmp_path / "remote")),
+        cryptor=XChaChaCryptor(),
+        key_cryptor=X25519KeyCryptor(priv, recipients),
+        adapter=orset_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=create,
+    )
+
+
+def test_two_recipient_replicas_converge(tmp_path):
+    priv_a, pub_a = generate_keypair()
+    priv_b, pub_b = generate_keypair()
+    roster = [pub_a, pub_b]
+
+    async def go():
+        c1 = await Core.open(make_opts(tmp_path, "a", priv_a, roster))
+        await c1.update(lambda s: s.add_ctx(c1.actor_id, b"x"))
+        c2 = await Core.open(make_opts(tmp_path, "b", priv_b, roster))
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.contains(b"x"))
+        # key material converged without any shared secret
+        k1 = c1._data.keys.latest_key()
+        k2 = c2._data.keys.latest_key()
+        assert k1.id == k2.id and k1.material == k2.material
+        assert c1.with_state(canonical_bytes) == c2.with_state(canonical_bytes)
+
+    run(go())
+
+
+def test_outsider_cannot_join(tmp_path):
+    priv_a, pub_a = generate_keypair()
+    priv_eve, _pub_eve = generate_keypair()
+
+    async def go():
+        c1 = await Core.open(make_opts(tmp_path, "a", priv_a, [pub_a]))
+        await c1.update(lambda s: s.add_ctx(c1.actor_id, b"x"))
+        # eve's public key is not in the roster: the keys blob must refuse
+        # to open, so she never obtains a data key
+        with pytest.raises((NotARecipient, CoreError)):
+            await Core.open(make_opts(tmp_path, "eve", priv_eve, [pub_a]))
+
+    run(go())
+
+
+def test_rotation_under_recipient_keys(tmp_path):
+    priv_a, pub_a = generate_keypair()
+    priv_b, pub_b = generate_keypair()
+    roster = [pub_a, pub_b]
+
+    async def go():
+        c1 = await Core.open(make_opts(tmp_path, "a", priv_a, roster))
+        await c1.update(lambda s: s.add_ctx(c1.actor_id, b"old"))
+        await c1.rotate_key()
+        await c1.update(lambda s: s.add_ctx(c1.actor_id, b"new"))
+        c2 = await Core.open(make_opts(tmp_path, "b", priv_b, roster))
+        await c2.read_remote()
+        assert set(c2.with_state(lambda s: s.members())) == {b"old", b"new"}
+
+    run(go())
+
+
+def test_stale_roster_writer_cannot_lock_out_peers(tmp_path):
+    """Regression: a device restarted with a stale roster must not seal
+    future key material away from peers an earlier writer admitted — the
+    roster converges grow-only from every blob it opens."""
+    priv_a, pub_a = generate_keypair()
+    priv_b, pub_b = generate_keypair()
+
+    async def go():
+        # A knows both devices; writes the initial key metadata
+        c_a = await Core.open(make_opts(tmp_path, "a", priv_a, [pub_a, pub_b]))
+        await c_a.update(lambda s: s.add_ctx(c_a.actor_id, b"x"))
+
+        # A restarts with a STALE roster (only itself) and rotates
+        kc = X25519KeyCryptor(priv_a, [])  # stale: B missing
+        opts = make_opts(tmp_path, "a2", priv_a, [])
+        opts.key_cryptor = kc
+        c_a2 = await Core.open(opts)
+        # opening ingested the old blob → roster converged to include B
+        assert pub_b in kc.recipients
+        await c_a2.rotate_key()
+        await c_a2.update(lambda s: s.add_ctx(c_a2.actor_id, b"y"))
+
+        # B can still read everything, including post-rotation writes
+        c_b = await Core.open(make_opts(tmp_path, "b", priv_b, [pub_a, pub_b]))
+        await c_b.read_remote()
+        assert set(c_b.with_state(lambda s: s.members())) == {b"x", b"y"}
+
+    run(go())
+
+
+def test_pinned_roster_revocation(tmp_path):
+    """pin_recipients=True is the deliberate revocation path: after a
+    rotation under a pinned roster, the revoked device cannot read keys
+    sealed from then on."""
+    priv_a, pub_a = generate_keypair()
+    priv_b, pub_b = generate_keypair()
+
+    async def go():
+        c_a = await Core.open(make_opts(tmp_path, "a", priv_a, [pub_a, pub_b]))
+        await c_a.update(lambda s: s.add_ctx(c_a.actor_id, b"x"))
+
+        # revoke B: pinned roster without B, then rotate
+        opts = make_opts(tmp_path, "a2", priv_a, [])
+        opts.key_cryptor = X25519KeyCryptor(priv_a, [pub_a], pin_recipients=True)
+        c_a2 = await Core.open(opts)
+        await c_a2.rotate_key()
+
+        with pytest.raises((NotARecipient, CoreError)):
+            await Core.open(make_opts(tmp_path, "b", priv_b, [pub_a, pub_b]))
+
+    run(go())
