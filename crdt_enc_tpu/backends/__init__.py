@@ -7,7 +7,14 @@ from .xchacha import AeadError, XChaChaCryptor
 
 # The X25519 backend needs the third-party `cryptography` package; load it
 # lazily (PEP 562) so environments without it keep every other backend.
-_X25519_NAMES = ("NotARecipient", "X25519KeyCryptor", "generate_keypair")
+# Deliberately NOT in __all__: star-imports must keep working without the
+# optional dependency.
+_X25519_NAMES = (
+    "NotARecipient",
+    "UntrustedSigner",
+    "X25519KeyCryptor",
+    "generate_identity",
+)
 
 
 def __getattr__(name):
@@ -28,12 +35,9 @@ __all__ = [
     "IdentityCryptor",
     "MemoryRemote",
     "MemoryStorage",
-    "NotARecipient",
     "PassphraseKeyCryptor",
     "PlainKeyCryptor",
     "WrongPassphrase",
-    "X25519KeyCryptor",
     "XChaChaCryptor",
     "content_name",
-    "generate_keypair",
 ]

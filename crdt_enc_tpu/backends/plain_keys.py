@@ -23,6 +23,11 @@ class PlainKeyCryptor(KeyCryptor):
     # a reader with the wrong backend fails the version check, not the parse.
     META_VERSION = KEYS_META_VERSION_1
     SUPPORTED_META_VERSIONS = SUPPORTED_KEYS_META_VERSIONS
+    # Exception types from _unprotect that skip just that register value
+    # (some backends cannot open every concurrent value, e.g. a blob
+    # sealed to a recipient set this replica is not in); an entirely
+    # unreadable register still raises.
+    DECODE_TOLERATES: tuple = ()
 
     def __init__(self):
         self._reg = MVReg()
@@ -44,7 +49,8 @@ class PlainKeyCryptor(KeyCryptor):
         Keys CRDT, install on the core (gpgme lib.rs:79-105)."""
         self._reg.merge(reg)
         keys = await decode_version_bytes_mvreg(
-            self._reg, self.SUPPORTED_META_VERSIONS, Keys, transform=self._unprotect
+            self._reg, self.SUPPORTED_META_VERSIONS, Keys,
+            transform=self._unprotect, tolerate=self.DECODE_TOLERATES,
         )
         if keys is not None and self._core is not None:
             self._core.set_keys(keys)

@@ -1,41 +1,56 @@
-"""Recipient-keyed (asymmetric) key-cryptor backend.
+"""Recipient-keyed (asymmetric) key-cryptor backend with signed blobs.
 
 The real version of what the reference's gpgme backend intended and left as
 a stub (crdt-enc-gpgme/src/lib.rs:131-175: the PGP encrypt-to-recipients
 calls are commented out; its unused ``Meta`` CRDT at lib.rs:51-66 was a set
 of recipient fingerprints): the serialized Keys CRDT is sealed *to a set of
-recipient public keys*, so replicas never share a secret out of band — each
-holds its own X25519 private key, and adding a device means adding its
-public key to the recipient set, not re-encrypting any data.
+recipient identities* and *signed by the writer*, so replicas never share a
+secret out of band — each holds its own identity keypair, and adding a
+device means adding its public identity to the roster.
+
+Threat model: the storage layer is UNTRUSTED (a synced directory anyone may
+write to).  Confidentiality comes from the recipient seal; integrity and
+roster trust come from the signature: a blob is accepted only if signed by
+an identity this replica already trusts, so hostile storage can neither
+tamper with blobs (signature breaks), forge Keys metadata (no trusted
+signing key), nor poison the roster (recipients are unioned only from
+blobs whose signature verified).  Trust is anchored at the locally
+configured roster and grows only through blobs trusted identities signed
+— a grow-only trust chain, the converged recipient-set CRDT the reference
+declared but never used.
+
+Identity = X25519 (sealing) + Ed25519 (signing); ``generate_identity()``
+returns 64-byte (private, public) bundles (x ‖ ed halves).
 
 Wrap format (content under ``X25519_KEYS_META_VERSION_1``):
 
-    msgpack([eph_pub, sealed, {recipient_pub: nonce ‖ wrapped_blob_key}])
+    msgpack([body, signer_pub_bundle, signature])
+    body = msgpack([eph_pub, sealed, roster, {x_pub: nonce ‖ wrapped_key}])
 
 One random 32-byte blob key seals the Keys blob through the native
-XChaCha20-Poly1305 envelope (same bytes as the data path); for each
-recipient the blob key is wrapped under ChaCha20-Poly1305 with a key from
-``HKDF-SHA256(X25519(eph_priv, recipient_pub), info = tag ‖ eph_pub ‖
-recipient_pub)``.  The ephemeral keypair is fresh per write, so two
-replicas writing the same Keys produce distinct blobs — convergence
-happens at the CRDT layer after unwrap, like the other key backends.
+XChaCha20-Poly1305 envelope; per recipient the blob key is wrapped under
+ChaCha20-Poly1305 with ``HKDF-SHA256(X25519(eph_priv, recipient_x_pub),
+info = tag ‖ eph_pub ‖ recipient_x_pub)``.  ``roster`` is the full list of
+recipient public identity bundles (public data); the Ed25519 signature
+covers the whole body, binding roster and wraps together.  The ephemeral
+keypair is fresh per write, so identical Keys produce distinct blobs —
+convergence happens at the CRDT layer after unwrap.
 
-The recipient set itself converges grow-only: the wrap map is keyed by the
-full recipient public keys (they are public), and every blob a replica
-successfully opens unions its recipients into the local roster — so a
-replica restarted with a stale roster cannot silently lock peers out of
-future key material (this realizes the converged recipient-set ``Meta``
-CRDT the reference's gpgme backend declared but never used,
-crdt-enc-gpgme/src/lib.rs:51-66).  Deliberate revocation opts out with
-``pin_recipients=True`` + a key rotation.
+Revocation: construct with ``pin_recipients=True`` (no roster growth),
+drop the revoked identity, and ``core.rotate_key()`` — the revoked device
+never receives keys sealed from then on (it keeps those it already saw).
 """
 
 from __future__ import annotations
 
 import secrets
 
-from cryptography.exceptions import InvalidTag
+from cryptography.exceptions import InvalidSignature, InvalidTag
 from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
 from cryptography.hazmat.primitives.asymmetric.x25519 import (
     X25519PrivateKey,
     X25519PublicKey,
@@ -52,111 +67,149 @@ from . import xchacha
 from .plain_keys import PlainKeyCryptor
 
 _HKDF_TAG = b"crdt-enc-tpu x25519 keys v1"
-PUB_LEN = 32
+HALF_LEN = 32
+BUNDLE_LEN = 64  # x25519 half ‖ ed25519 half
 _NONCE_LEN = 12
 
 
 class NotARecipient(Exception):
-    """This replica's public key is not in the blob's recipient set (or the
-    blob is malformed / fails authentication)."""
+    """This replica's identity is not in the blob's recipient set (or the
+    blob is malformed / fails AEAD authentication)."""
 
 
-def generate_keypair() -> tuple[bytes, bytes]:
-    """A fresh (private, public) raw-byte X25519 pair."""
-    priv = X25519PrivateKey.generate()
-    return (
-        priv.private_bytes_raw(),
-        priv.public_key().public_bytes_raw(),
+class UntrustedSigner(Exception):
+    """The blob's signature is missing/invalid, or the signer is not a
+    trusted identity."""
+
+
+def generate_identity() -> tuple[bytes, bytes]:
+    """A fresh identity: 64-byte (private, public) bundles, each the
+    X25519 half followed by the Ed25519 half."""
+    x = X25519PrivateKey.generate()
+    ed = Ed25519PrivateKey.generate()
+    priv = x.private_bytes_raw() + ed.private_bytes_raw()
+    pub = (
+        x.public_key().public_bytes_raw()
+        + ed.public_key().public_bytes_raw()
     )
+    return priv, pub
 
 
-def _kek(shared: bytes, eph_pub: bytes, recipient_pub: bytes) -> bytes:
+def _split(bundle: bytes, what: str) -> tuple[bytes, bytes]:
+    bundle = bytes(bundle)
+    if len(bundle) != BUNDLE_LEN:
+        raise ValueError(f"{what} bundle must be {BUNDLE_LEN} bytes")
+    return bundle[:HALF_LEN], bundle[HALF_LEN:]
+
+
+def _kek(shared: bytes, eph_pub: bytes, recipient_x_pub: bytes) -> bytes:
     return HKDF(
         algorithm=hashes.SHA256(),
         length=32,
         salt=None,
-        info=_HKDF_TAG + eph_pub + recipient_pub,
+        info=_HKDF_TAG + eph_pub + recipient_x_pub,
     ).derive(shared)
 
 
-def wrap_blob(raw: bytes, recipients: list[bytes]) -> bytes:
-    """Seal ``raw`` to every recipient public key."""
+def wrap_blob(raw: bytes, recipients: list[bytes], signer_priv: bytes) -> bytes:
+    """Seal ``raw`` to every recipient identity and sign as ``signer_priv``."""
     if not recipients:
-        raise ValueError("at least one recipient public key required")
+        raise ValueError("at least one recipient identity required")
+    sx_priv, sed_priv = _split(signer_priv, "signer private")
     blob_key = secrets.token_bytes(xchacha.KEY_LEN)
     sealed = xchacha.encrypt_blob(blob_key, raw)
     eph = X25519PrivateKey.generate()
     eph_pub = eph.public_key().public_bytes_raw()
+    roster = []
     wraps = {}
-    for pub in recipients:
-        pub = bytes(pub)
-        if len(pub) != PUB_LEN:
-            raise ValueError(f"recipient public key must be {PUB_LEN} bytes")
-        shared = eph.exchange(X25519PublicKey.from_public_bytes(pub))
+    for bundle in recipients:
+        x_pub, _ = _split(bundle, "recipient public")
+        roster.append(bytes(bundle))
+        shared = eph.exchange(X25519PublicKey.from_public_bytes(x_pub))
         nonce = secrets.token_bytes(_NONCE_LEN)
-        wrapped = ChaCha20Poly1305(_kek(shared, eph_pub, pub)).encrypt(
-            nonce, blob_key, b""
-        )
-        wraps[pub] = nonce + wrapped
-    return codec.pack([eph_pub, sealed, wraps])
+        wraps[x_pub] = nonce + ChaCha20Poly1305(
+            _kek(shared, eph_pub, x_pub)
+        ).encrypt(nonce, blob_key, b"")
+    body = codec.pack([eph_pub, sealed, roster, wraps])
+    ed = Ed25519PrivateKey.from_private_bytes(sed_priv)
+    signer_pub = (
+        X25519PrivateKey.from_private_bytes(sx_priv)
+        .public_key()
+        .public_bytes_raw()
+        + ed.public_key().public_bytes_raw()
+    )
+    return codec.pack([body, signer_pub, ed.sign(body)])
 
 
-def unwrap_blob(private_key: bytes, blob: bytes) -> tuple[bytes, list[bytes]]:
-    """Open a sealed Keys blob with this replica's private key.
+def unwrap_blob(
+    private_bundle: bytes, blob: bytes, trusted: set[bytes] | frozenset[bytes]
+) -> tuple[bytes, list[bytes], bytes]:
+    """Open a sealed Keys blob: verify the signer is trusted and the
+    signature covers the body, then decrypt this replica's entry.
 
-    Returns ``(cleartext, recipients)`` — the blob's recipient public keys,
-    so callers can converge their roster."""
-    priv = X25519PrivateKey.from_private_bytes(private_key)
-    my_pub = priv.public_key().public_bytes_raw()
+    Returns ``(cleartext, roster, signer_pub_bundle)`` — the verified
+    recipient identity list, safe to union into a trust set.
+    """
+    my_x_priv, _ = _split(private_bundle, "private")
     try:
-        eph_pub, sealed, wraps = codec.unpack(blob)
-        if not isinstance(eph_pub, (bytes, bytearray)) or not isinstance(
-            sealed, (bytes, bytearray)
-        ):
-            raise TypeError("eph_pub/sealed must be binary")
+        body, signer_pub, sig = codec.unpack(blob)
+        body, signer_pub, sig = bytes(body), bytes(signer_pub), bytes(sig)
+        _, signer_ed = _split(signer_pub, "signer public")
+    except Exception as e:
+        raise UntrustedSigner(f"malformed signed wrap: {e}") from e
+    if signer_pub not in trusted:
+        raise UntrustedSigner("blob signed by an identity this replica does not trust")
+    try:
+        Ed25519PublicKey.from_public_bytes(signer_ed).verify(sig, body)
+    except InvalidSignature as e:
+        raise UntrustedSigner("signature verification failed") from e
+
+    priv = X25519PrivateKey.from_private_bytes(my_x_priv)
+    my_x_pub = priv.public_key().public_bytes_raw()
+    try:
+        eph_pub, sealed, roster, wraps = codec.unpack(body)
         eph_pub, sealed = bytes(eph_pub), bytes(sealed)
-        if len(eph_pub) != PUB_LEN:
+        if len(eph_pub) != HALF_LEN:
             raise ValueError("bad ephemeral public key length")
-        recipients = [bytes(p) for p in wraps]
-        if any(len(p) != PUB_LEN for p in recipients):
-            raise ValueError("bad recipient public key length")
-        entry = wraps.get(my_pub)
-    except NotARecipient:
-        raise
+        roster = [bytes(b) for b in roster]
+        if any(len(b) != BUNDLE_LEN for b in roster):
+            raise ValueError("bad roster bundle length")
+        entry = wraps.get(my_x_pub)
     except Exception as e:
         raise NotARecipient(f"malformed recipient wrap: {e}") from e
     if entry is None:
         raise NotARecipient(
-            "this replica's key is not in the blob's recipient set"
+            "this replica's identity is not in the blob's recipient set"
         )
     entry = bytes(entry)
     if len(entry) < _NONCE_LEN + 16:
         raise NotARecipient("recipient wrap entry too short")
     shared = priv.exchange(X25519PublicKey.from_public_bytes(eph_pub))
     try:
-        blob_key = ChaCha20Poly1305(_kek(shared, eph_pub, my_pub)).decrypt(
+        blob_key = ChaCha20Poly1305(_kek(shared, eph_pub, my_x_pub)).decrypt(
             entry[:_NONCE_LEN], entry[_NONCE_LEN:], b""
         )
-        return xchacha.decrypt_blob(blob_key, sealed), recipients
+        return xchacha.decrypt_blob(blob_key, sealed), roster, signer_pub
     except (InvalidTag, xchacha.AeadError) as e:
         raise NotARecipient(f"authentication failed: {e}") from e
 
 
 class X25519KeyCryptor(PlainKeyCryptor):
-    """Key management sealed to recipient public keys (no shared secret).
+    """Key management sealed to recipient identities and signed by the
+    writer (no shared secret).
 
-    ``private_key`` is this replica's raw 32-byte X25519 private key
-    (``generate_keypair()``); ``recipients`` are the public keys allowed to
-    read the key material — this replica's own public key is included
-    automatically, so a lone replica needs no recipient list at all.
+    ``private_bundle`` is this replica's 64-byte private identity
+    (``generate_identity()``); ``recipients`` are the public identity
+    bundles allowed to read key material — this replica's own identity is
+    included automatically, so a lone replica needs no roster at all.
 
-    The roster converges grow-only by default: recipients of every blob
-    this replica successfully opens are unioned in, so a device restarted
-    with a stale config cannot seal future key material away from peers an
-    earlier writer admitted.  ``pin_recipients=True`` disables the union
-    for deliberate revocation (follow with ``core.rotate_key()`` so a new
-    key exists that the revoked device never receives; it keeps the old
-    keys it already saw).
+    Trust & roster converge grow-only by default: a blob is only accepted
+    if signed by an already-trusted identity, and the rosters of accepted
+    blobs are unioned in — so a device restarted with a stale config
+    cannot lock peers out, while hostile storage can never inject
+    identities (it holds no trusted signing key).  ``pin_recipients=True``
+    freezes the roster for deliberate revocation (follow with
+    ``core.rotate_key()``).
     """
 
     META_VERSION = X25519_KEYS_META_VERSION_1
@@ -164,39 +217,55 @@ class X25519KeyCryptor(PlainKeyCryptor):
 
     def __init__(
         self,
-        private_key: bytes,
+        private_bundle: bytes,
         recipients: list[bytes] = (),
         *,
         pin_recipients: bool = False,
     ):
         super().__init__()
-        self._priv = bytes(private_key)
-        my_pub = X25519PrivateKey.from_private_bytes(
-            self._priv
-        ).public_key().public_bytes_raw()
+        self._priv = bytes(private_bundle)
+        _split(self._priv, "private")  # validate early
+        my_pub = self.public_identity
         pubs = [bytes(p) for p in recipients]
+        for p in pubs:
+            _split(p, "recipient public")
         if my_pub not in pubs:
             pubs.append(my_pub)
         self._recipients = pubs
         self._pinned = pin_recipients
 
     @property
-    def public_key(self) -> bytes:
-        return X25519PrivateKey.from_private_bytes(
-            self._priv
-        ).public_key().public_bytes_raw()
+    def public_identity(self) -> bytes:
+        x, ed = _split(self._priv, "private")
+        return (
+            X25519PrivateKey.from_private_bytes(x)
+            .public_key()
+            .public_bytes_raw()
+            + Ed25519PrivateKey.from_private_bytes(ed)
+            .public_key()
+            .public_bytes_raw()
+        )
 
     @property
     def recipients(self) -> tuple[bytes, ...]:
         return tuple(self._recipients)
 
     async def _protect(self, raw: bytes) -> bytes:
-        return wrap_blob(raw, self._recipients)
+        return wrap_blob(raw, self._recipients, self._priv)
 
     async def _unprotect(self, vb) -> bytes:
-        clear, seen = unwrap_blob(self._priv, vb.content)
+        clear, roster, _signer = unwrap_blob(
+            self._priv, vb.content, trusted=set(self._recipients)
+        )
         if not self._pinned:
-            for pub in seen:
+            for pub in roster:
                 if pub not in self._recipients:
                     self._recipients.append(pub)
         return clear
+
+    # A register may hold concurrent values some of which this replica
+    # cannot open (e.g. one written by a stale process sealing only to
+    # itself).  Readable values must still decode — skipping the
+    # unreadable value is safe because its writer re-converges its own
+    # keys on its next write.
+    DECODE_TOLERATES = (NotARecipient, UntrustedSigner)

@@ -34,4 +34,7 @@ class Cryptor(ABC):
 
     async def init(self, core) -> None: ...
 
-    async def set_remote_meta(self, meta) -> None: ...
+    async def set_remote_meta(self, meta) -> None:
+        """Converged config register changed.  Concurrent ``read_remote``
+        calls may deliver snapshots out of order — MERGE the register
+        (it is a CRDT), never replace local state with it."""

@@ -87,4 +87,9 @@ class Storage(ABC):
 
     async def set_remote_meta(self, meta) -> None:
         """This plugin's converged config blob changed (an MVReg of opaque
-        VersionBytes, reference lib.rs:596-609)."""
+        VersionBytes, reference lib.rs:596-609).
+
+        Delivery-order contract: concurrent ``read_remote`` calls may
+        deliver register snapshots out of order.  The register is a CRDT —
+        implementations must MERGE it into their own copy (stale snapshots
+        then converge to no-ops), never replace state with it."""

@@ -1,0 +1,93 @@
+"""Chunked (bounded-memory) folds for op streams larger than device memory.
+
+The long-context story (SURVEY.md §2.3): a replica's op log is the
+framework's "sequence", and because the fold is associative the log can be
+folded blockwise — the same trick ring attention uses for its associative
+accumulator.  A 100M-op compaction therefore never materializes the whole
+batch on device: fixed-size chunks stream through one compiled fold whose
+state planes are **donated** (`jax.jit(donate_argnums=...)`), so XLA reuses
+the plane buffers in place and device memory stays at
+``one chunk + one set of planes`` regardless of stream length.
+
+Exactness: chunked ≡ whole-batch under the causal-delivery contract the
+core guarantees (per-actor op files apply in version order, core.py
+``_read_remote_ops``) — each chunk's stale-dot filter then sees a clock
+that only ever rejects true replays.  The per-op host loop is precisely
+the chunk-size-1 instance of this fold, so the existing host-equality
+tests pin the semantics at both extremes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from .orset import orset_fold
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_members", "num_replicas", "impl", "small_counters"),
+    donate_argnums=(0, 1, 2),
+)
+def _fold_donated(
+    clock, add, rm, kind, member, actor, counter,
+    *, num_members, num_replicas, impl, small_counters,
+):
+    return orset_fold(
+        clock, add, rm, kind, member, actor, counter,
+        num_members=num_members, num_replicas=num_replicas,
+        impl=impl, small_counters=small_counters,
+    )
+
+
+def iter_orset_chunks(kind, member, actor, counter, chunk_rows: int, num_replicas: int):
+    """Slice flat op columns into fixed-shape chunks (the tail is padded
+    with ``actor == num_replicas`` sentinel rows, which every kernel
+    masks out) — one shape ⇒ one compilation for the whole stream."""
+    n = len(kind)
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        pad = chunk_rows - (hi - lo)
+        k = np.asarray(kind[lo:hi], np.int8)
+        m = np.asarray(member[lo:hi], np.int32)
+        a = np.asarray(actor[lo:hi], np.int32)
+        c = np.asarray(counter[lo:hi], np.int32)
+        if pad:
+            k = np.concatenate([k, np.zeros(pad, np.int8)])
+            m = np.concatenate([m, np.zeros(pad, np.int32)])
+            a = np.concatenate([a, np.full(pad, num_replicas, np.int32)])
+            c = np.concatenate([c, np.zeros(pad, np.int32)])
+        yield k, m, a, c
+
+
+def orset_fold_stream(
+    clock0,
+    add0,
+    rm0,
+    chunks,
+    *,
+    num_members: int,
+    num_replicas: int,
+    impl: str = "fused",
+    small_counters: bool = False,
+):
+    """Fold an iterable of fixed-shape op chunks into the state planes.
+
+    ``chunks`` yields ``(kind, member, actor, counter)`` tuples of one
+    common row count (see :func:`iter_orset_chunks`).  Returns the folded
+    ``(clock, add, rm)`` device arrays.  The planes are donated between
+    chunks — do not reuse the input arrays after calling.
+    """
+    clock = jax.device_put(np.asarray(clock0, np.int32))
+    add = jax.device_put(np.asarray(add0, np.int32))
+    rm = jax.device_put(np.asarray(rm0, np.int32))
+    for kind, member, actor, counter in chunks:
+        clock, add, rm = _fold_donated(
+            clock, add, rm, kind, member, actor, counter,
+            num_members=num_members, num_replicas=num_replicas,
+            impl=impl, small_counters=small_counters,
+        )
+    return clock, add, rm

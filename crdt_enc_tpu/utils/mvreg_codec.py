@@ -12,10 +12,13 @@ writer's add-context so it supersedes everything it saw (mod.rs:128-163).
 from __future__ import annotations
 
 import inspect
+import logging
 from typing import Awaitable, Callable, Iterable
 
 from . import codec
 from .version_bytes import VersionBytes
+
+logger = logging.getLogger("crdt_enc_tpu.mvreg_codec")
 
 
 async def _maybe_await(x):
@@ -29,25 +32,42 @@ async def decode_version_bytes_mvreg(
     supported_versions: Iterable[bytes],
     crdt_cls,
     transform: Callable[[VersionBytes], bytes | Awaitable[bytes]] | None = None,
+    tolerate: tuple = (),
 ):
     """Fold all concurrent register values into one ``crdt_cls`` instance.
 
     ``transform`` maps the version-checked blob to cleartext msgpack (e.g.
     decrypt); default takes the content as-is.  Returns None if the register
     is empty.
+
+    ``tolerate``: exception types from ``transform`` that skip just that
+    value (e.g. a concurrent blob sealed to a recipient set this replica
+    is not in).  If EVERY value fails, the first error propagates — an
+    entirely unreadable register must stay loud.
     """
     values = mvreg.read().values
     if not values:
         return None
     merged = None
+    first_err = None
     for obj in values:
         vb = VersionBytes.from_obj(obj).ensure_versions(supported_versions)
-        raw = await _maybe_await(transform(vb)) if transform else vb.content
+        try:
+            raw = await _maybe_await(transform(vb)) if transform else vb.content
+        except tolerate as e:
+            # visible, not fatal: could be a stale concurrent writer — or a
+            # forgery attempt by whoever controls the storage
+            logger.warning("skipping unreadable register value: %s", e)
+            if first_err is None:
+                first_err = e
+            continue
         value = crdt_cls.from_obj(codec.unpack(raw))
         if merged is None:
             merged = value
         else:
             merged.merge(value)
+    if merged is None and first_err is not None:
+        raise first_err
     return merged
 
 

@@ -422,3 +422,31 @@ def test_orset_fold_coo_matches_dense():
         np.asarray(is_max), members, replicas,
     )
     assert canonical_bytes(coo_state) == canonical_bytes(dense_state)
+
+
+def test_orset_fold_stream_matches_whole_batch():
+    """Chunked/donated streaming fold ≡ whole-batch fold ≡ host, on a
+    causal history (the delivery contract the core guarantees)."""
+    host, ops = run_script(
+        [(i % 5, "add" if i % 4 else "rm", i % 4) for i in range(120)]
+    )
+    if not ops:
+        return
+    members, replicas = fixed_vocabs()
+    cols = K.orset_ops_to_columns(ops, members, replicas)
+    E, R = len(members), len(replicas)
+
+    whole = fold_on_device(ORSet(), ops)
+
+    clock, add, rm = K.orset_fold_stream(
+        np.zeros(R, np.int32), np.zeros((E, R), np.int32),
+        np.zeros((E, R), np.int32),
+        K.iter_orset_chunks(cols.kind, cols.member, cols.actor, cols.counter,
+                            chunk_rows=16, num_replicas=R),
+        num_members=E, num_replicas=R,
+    )
+    streamed = K.orset_planes_to_state(
+        np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+    )
+    assert canonical_bytes(streamed) == canonical_bytes(whole)
+    assert canonical_bytes(streamed) == canonical_bytes(host)

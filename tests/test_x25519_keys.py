@@ -1,23 +1,25 @@
-"""Recipient-keyed (X25519) key cryptor: the asymmetric backend the
+"""Recipient-keyed (X25519+Ed25519) key cryptor: the asymmetric backend the
 reference's gpgme plugin stubbed out (its PGP calls are commented out,
 crdt-enc-gpgme/src/lib.rs:131-175).  No shared secret: each replica holds a
-private key; readability is membership in the recipient set."""
+private identity; readability is membership in a signed recipient roster,
+and hostile storage can neither tamper, forge, nor poison the roster."""
 
 import asyncio
 
 import pytest
 
-from crdt_enc_tpu.backends import (
-    FsStorage,
-    IdentityCryptor,
+from crdt_enc_tpu.backends import FsStorage, XChaChaCryptor
+from crdt_enc_tpu.backends.x25519_keys import (
     NotARecipient,
+    UntrustedSigner,
     X25519KeyCryptor,
-    XChaChaCryptor,
-    generate_keypair,
+    generate_identity,
+    unwrap_blob,
+    wrap_blob,
 )
-from crdt_enc_tpu.backends.x25519_keys import unwrap_blob, wrap_blob
 from crdt_enc_tpu.core import Core, CoreError, OpenOptions, orset_adapter
 from crdt_enc_tpu.models import canonical_bytes
+from crdt_enc_tpu.utils import codec
 from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
 
 
@@ -29,45 +31,72 @@ def run(coro):
 
 
 def test_wrap_unwrap_roundtrip_multi_recipient():
-    priv_a, pub_a = generate_keypair()
-    priv_b, pub_b = generate_keypair()
-    blob = wrap_blob(b"the keys crdt", [pub_a, pub_b])
-    clear_a, seen_a = unwrap_blob(priv_a, blob)
-    clear_b, seen_b = unwrap_blob(priv_b, blob)
+    priv_a, pub_a = generate_identity()
+    priv_b, pub_b = generate_identity()
+    blob = wrap_blob(b"the keys crdt", [pub_a, pub_b], priv_a)
+    trusted = {pub_a, pub_b}
+    clear_a, roster_a, signer_a = unwrap_blob(priv_a, blob, trusted)
+    clear_b, roster_b, signer_b = unwrap_blob(priv_b, blob, trusted)
     assert clear_a == clear_b == b"the keys crdt"
-    # the blob carries its recipient set, enabling roster convergence
-    assert set(seen_a) == set(seen_b) == {pub_a, pub_b}
+    assert set(roster_a) == set(roster_b) == trusted
+    assert signer_a == signer_b == pub_a
 
 
 def test_non_recipient_rejected():
-    _, pub_a = generate_keypair()
-    priv_eve, _ = generate_keypair()
-    blob = wrap_blob(b"secret", [pub_a])
+    priv_a, pub_a = generate_identity()
+    priv_eve, pub_eve = generate_identity()
+    blob = wrap_blob(b"secret", [pub_a], priv_a)
     with pytest.raises(NotARecipient):
-        unwrap_blob(priv_eve, blob)
+        # eve trusts A (knows the real roster) but is not sealed to
+        unwrap_blob(priv_eve, blob, {pub_a, pub_eve})
 
 
-def test_tampered_blob_rejected():
-    priv_a, pub_a = generate_keypair()
-    blob = bytearray(wrap_blob(b"secret", [pub_a]))
+def test_forged_blob_rejected():
+    """Hostile storage can build a valid-looking blob (sealing needs only
+    public keys) — but it cannot sign as a trusted identity."""
+    priv_a, pub_a = generate_identity()
+    priv_eve, pub_eve = generate_identity()
+    forged = wrap_blob(b"attacker keys", [pub_a, pub_eve], priv_eve)
+    with pytest.raises(UntrustedSigner):
+        unwrap_blob(priv_a, forged, {pub_a})
+
+
+def test_tampered_roster_rejected():
+    """Appending an attacker identity to the wraps/roster breaks the
+    signature — the roster-poisoning vector the signing exists to close."""
+    priv_a, pub_a = generate_identity()
+    _, pub_eve = generate_identity()
+    blob = wrap_blob(b"secret", [pub_a], priv_a)
+    body, signer_pub, sig = codec.unpack(blob)
+    eph_pub, sealed, roster, wraps = codec.unpack(bytes(body))
+    roster = [bytes(r) for r in roster] + [pub_eve]
+    tampered_body = codec.pack([bytes(eph_pub), bytes(sealed), roster, wraps])
+    tampered = codec.pack([tampered_body, signer_pub, sig])
+    with pytest.raises(UntrustedSigner):
+        unwrap_blob(priv_a, tampered, {pub_a})
+
+
+def test_tampered_bytes_rejected():
+    priv_a, pub_a = generate_identity()
+    blob = bytearray(wrap_blob(b"secret", [pub_a], priv_a))
     blob[-1] ^= 0x01
-    with pytest.raises(NotARecipient):
-        unwrap_blob(priv_a, bytes(blob))
+    with pytest.raises((UntrustedSigner, NotARecipient)):
+        unwrap_blob(priv_a, bytes(blob), {pub_a})
 
 
 def test_fresh_ephemeral_per_write():
-    priv_a, pub_a = generate_keypair()
-    assert wrap_blob(b"x", [pub_a]) != wrap_blob(b"x", [pub_a])
+    priv_a, pub_a = generate_identity()
+    assert wrap_blob(b"x", [pub_a], priv_a) != wrap_blob(b"x", [pub_a], priv_a)
 
 
 # ---- through the core -----------------------------------------------------
 
 
-def make_opts(tmp_path, name, priv, recipients, create=True):
+def make_opts(tmp_path, name, priv, recipients, create=True, **kc_kw):
     return OpenOptions(
         storage=FsStorage(str(tmp_path / name), str(tmp_path / "remote")),
         cryptor=XChaChaCryptor(),
-        key_cryptor=X25519KeyCryptor(priv, recipients),
+        key_cryptor=X25519KeyCryptor(priv, recipients, **kc_kw),
         adapter=orset_adapter(),
         supported_data_versions=(DEFAULT_DATA_VERSION_1,),
         current_data_version=DEFAULT_DATA_VERSION_1,
@@ -76,8 +105,8 @@ def make_opts(tmp_path, name, priv, recipients, create=True):
 
 
 def test_two_recipient_replicas_converge(tmp_path):
-    priv_a, pub_a = generate_keypair()
-    priv_b, pub_b = generate_keypair()
+    priv_a, pub_a = generate_identity()
+    priv_b, pub_b = generate_identity()
     roster = [pub_a, pub_b]
 
     async def go():
@@ -96,14 +125,14 @@ def test_two_recipient_replicas_converge(tmp_path):
 
 
 def test_outsider_cannot_join(tmp_path):
-    priv_a, pub_a = generate_keypair()
-    priv_eve, _pub_eve = generate_keypair()
+    priv_a, pub_a = generate_identity()
+    priv_eve, pub_eve = generate_identity()
 
     async def go():
         c1 = await Core.open(make_opts(tmp_path, "a", priv_a, [pub_a]))
         await c1.update(lambda s: s.add_ctx(c1.actor_id, b"x"))
-        # eve's public key is not in the roster: the keys blob must refuse
-        # to open, so she never obtains a data key
+        # eve knows the roster but her identity is not sealed to: she never
+        # obtains a data key
         with pytest.raises((NotARecipient, CoreError)):
             await Core.open(make_opts(tmp_path, "eve", priv_eve, [pub_a]))
 
@@ -111,8 +140,8 @@ def test_outsider_cannot_join(tmp_path):
 
 
 def test_rotation_under_recipient_keys(tmp_path):
-    priv_a, pub_a = generate_keypair()
-    priv_b, pub_b = generate_keypair()
+    priv_a, pub_a = generate_identity()
+    priv_b, pub_b = generate_identity()
     roster = [pub_a, pub_b]
 
     async def go():
@@ -130,9 +159,9 @@ def test_rotation_under_recipient_keys(tmp_path):
 def test_stale_roster_writer_cannot_lock_out_peers(tmp_path):
     """Regression: a device restarted with a stale roster must not seal
     future key material away from peers an earlier writer admitted — the
-    roster converges grow-only from every blob it opens."""
-    priv_a, pub_a = generate_keypair()
-    priv_b, pub_b = generate_keypair()
+    roster converges grow-only from every VERIFIED blob it opens."""
+    priv_a, pub_a = generate_identity()
+    priv_b, pub_b = generate_identity()
 
     async def go():
         # A knows both devices; writes the initial key metadata
@@ -144,7 +173,8 @@ def test_stale_roster_writer_cannot_lock_out_peers(tmp_path):
         opts = make_opts(tmp_path, "a2", priv_a, [])
         opts.key_cryptor = kc
         c_a2 = await Core.open(opts)
-        # opening ingested the old blob → roster converged to include B
+        # opening ingested A's old (self-signed, trusted) blob → roster
+        # converged to include B
         assert pub_b in kc.recipients
         await c_a2.rotate_key()
         await c_a2.update(lambda s: s.add_ctx(c_a2.actor_id, b"y"))
@@ -161,8 +191,8 @@ def test_pinned_roster_revocation(tmp_path):
     """pin_recipients=True is the deliberate revocation path: after a
     rotation under a pinned roster, the revoked device cannot read keys
     sealed from then on."""
-    priv_a, pub_a = generate_keypair()
-    priv_b, pub_b = generate_keypair()
+    priv_a, pub_a = generate_identity()
+    priv_b, pub_b = generate_identity()
 
     async def go():
         c_a = await Core.open(make_opts(tmp_path, "a", priv_a, [pub_a, pub_b]))
@@ -176,5 +206,57 @@ def test_pinned_roster_revocation(tmp_path):
 
         with pytest.raises((NotARecipient, CoreError)):
             await Core.open(make_opts(tmp_path, "b", priv_b, [pub_a, pub_b]))
+
+    run(go())
+
+
+def test_unreadable_concurrent_value_tolerated(tmp_path):
+    """A register holding one value this replica can open and one it
+    cannot (signed by a trusted peer but sealed only to that peer — a
+    stale concurrent writer) must still decode — per-value tolerance,
+    not all-or-nothing (DECODE_TOLERATES)."""
+    import uuid as uuidm
+
+    from crdt_enc_tpu.core.core import RemoteMeta
+    from crdt_enc_tpu.core.key_cryptor import Key, Keys
+    from crdt_enc_tpu.models import MVReg
+    from crdt_enc_tpu.utils import VersionBytes
+    from crdt_enc_tpu.utils.mvreg_codec import encode_version_bytes_mvreg
+    from crdt_enc_tpu.utils.versions import CURRENT_CONTAINER_VERSION
+
+    priv_a, pub_a = generate_identity()
+    priv_b, pub_b = generate_identity()
+
+    async def go():
+        c_a = await Core.open(make_opts(tmp_path, "a", priv_a, [pub_a, pub_b]))
+        await c_a.update(lambda s: s.add_ctx(c_a.actor_id, b"x"))
+
+        # B (trusted by A) concurrently writes key metadata sealed ONLY to
+        # itself — craft the register value directly, as a stale process
+        # that never read A's metadata would produce it
+        kc_b = X25519KeyCryptor(priv_b, [pub_b], pin_recipients=True)
+        keys_b = Keys()
+        keys_b.insert_latest_key(
+            uuidm.uuid4().bytes,
+            Key.new(VersionBytes(DEFAULT_DATA_VERSION_1, b"\x00" * 32)),
+        )
+        reg = MVReg()
+        await encode_version_bytes_mvreg(
+            reg, keys_b, uuidm.uuid4().bytes, kc_b.META_VERSION,
+            transform=kc_b._protect,
+        )
+        inj = FsStorage(str(tmp_path / "inj"), str(tmp_path / "remote"))
+        rm = RemoteMeta(key_cryptor=reg)
+        await inj.store_remote_meta(
+            VersionBytes(CURRENT_CONTAINER_VERSION, codec.pack(rm.to_obj())).serialize()
+        )
+
+        # A re-reads: the register now holds A's value (readable) and B's
+        # (trusted signer, but A is not a recipient) — must not raise, and
+        # A's own key material must survive
+        await c_a.read_remote()
+        assert c_a.with_state(lambda s: s.contains(b"x"))
+        assert c_a._data.keys.latest_key() is not None
+        await c_a.update(lambda s: s.add_ctx(c_a.actor_id, b"y"))
 
     run(go())
