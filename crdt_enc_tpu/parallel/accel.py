@@ -66,6 +66,9 @@ class TpuAccelerator(HostAccelerator):
     SPARSE_CELLS_PER_ROW = 64
     # …and below this many cells the dense planes are trivially cheap.
     SPARSE_MIN_CELLS = 1 << 22
+    # Dense batches beyond this many rows fold blockwise (ops/stream.py) so
+    # device memory stays at one chunk + planes however big the ingest.
+    STREAM_CHUNK_ROWS = 1 << 22
 
     def _use_sparse(self, E: int, R: int, n_rows: int) -> bool:
         cells = E * R
@@ -93,22 +96,34 @@ class TpuAccelerator(HostAccelerator):
             return K.orset_fold_sparse_host(
                 state, kind, member, actor, counter, members, replicas
             )
-        cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
-        K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
         clock0, add0, rm0 = K.orset_state_to_planes(
             state, members, replicas, scanned=True
         )
-        clock, add, rm = K.orset_fold(
-            clock0,
-            add0,
-            rm0,
-            cols.kind,
-            cols.member,
-            cols.actor,
-            cols.counter,
-            num_members=E,
-            num_replicas=R,
-        )
+        if n_rows > self.STREAM_CHUNK_ROWS:
+            # blockwise fold with donated plane buffers: bounded device
+            # memory for arbitrarily large ingests (ops/stream.py)
+            clock, add, rm = K.orset_fold_stream(
+                clock0, add0, rm0,
+                K.iter_orset_chunks(
+                    kind, member, actor, counter,
+                    self.STREAM_CHUNK_ROWS, R,
+                ),
+                num_members=E, num_replicas=R,
+            )
+        else:
+            cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
+            K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
+            clock, add, rm = K.orset_fold(
+                clock0,
+                add0,
+                rm0,
+                cols.kind,
+                cols.member,
+                cols.actor,
+                cols.counter,
+                num_members=E,
+                num_replicas=R,
+            )
         folded = K.orset_planes_to_state(
             np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
         )

@@ -189,3 +189,17 @@ def test_sparse_orset_fold_clock_retires_foreign_deferred():
     s = sparse_accel().fold_ops(s_sparse, list(late_adds))
     assert canonical_bytes(s) == canonical_bytes(h)
     assert 2 not in s.deferred  # horizon retired by the advanced clock
+
+
+def test_streamed_dense_fold_matches_unstreamed():
+    """Batches above STREAM_CHUNK_ROWS fold blockwise with donated plane
+    buffers; forcing a tiny chunk bound must not change a single byte."""
+    final, ops = _orset_script(n_ops=300, seed=13)
+    a = accel()
+    a.STREAM_CHUNK_ROWS = 32  # force many chunks
+    streamed = a.fold_ops(ORSet(), list(ops))
+    plain = accel().fold_ops(ORSet(), list(ops))
+    host = HostAccelerator().fold_ops(ORSet(), list(ops))
+    assert canonical_bytes(streamed) == canonical_bytes(plain)
+    assert canonical_bytes(streamed) == canonical_bytes(host)
+    assert canonical_bytes(streamed) == canonical_bytes(final)

@@ -121,6 +121,21 @@ def test_buf_read_all_equals_serialize():
     assert vb.buf().read_all() == vb.serialize()
 
 
+def test_buf_vectored_through_real_writev(tmp_path):
+    # the point of chunks_vectored: version‖content hit the kernel in one
+    # vectored syscall with zero concatenation
+    import os
+
+    vb = VersionBytes(V1, b"payload-bytes")
+    fd = os.open(str(tmp_path / "out"), os.O_WRONLY | os.O_CREAT, 0o644)
+    try:
+        written = os.writev(fd, vb.buf().chunks_vectored())
+    finally:
+        os.close(fd)
+    assert written == VERSION_LEN + len(b"payload-bytes")
+    assert (tmp_path / "out").read_bytes() == vb.serialize()
+
+
 def test_canonical_pack_sorts_map_keys():
     a = codec.pack({b"b": 1, b"a": 2})
     b = codec.pack({b"a": 2, b"b": 1})
