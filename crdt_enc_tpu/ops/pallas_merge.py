@@ -45,13 +45,15 @@ def _merge_step_kernel(clocks_ref, prev_run_ref, run_ref, adds_ref, rms_ref,
     def _():
         from .orset import merge_rule
 
-        # clocks stay (1, R)-shaped and broadcast over the member sublanes
-        # (keeps every intermediate ≥2-D for Mosaic); prev_run is the clock
-        # of the accumulated left fold, run the merged clock after this step
+        # clock blocks arrive (1, 1, R) — the singleton middle axis exists
+        # only to satisfy the TPU (8,128) tiling rule on the last two block
+        # dims; [0] yields (1, R), broadcasting over the member sublanes.
+        # prev_run is the clock of the accumulated left fold, run the
+        # merged clock after this step
         add, rm = merge_rule(
-            prev_run_ref[...], out_add_ref[...], out_rm_ref[...],
-            clocks_ref[...], adds_ref[0], rms_ref[0],
-            run_ref[...],
+            prev_run_ref[0], out_add_ref[...], out_rm_ref[...],
+            clocks_ref[0], adds_ref[0], rms_ref[0],
+            run_ref[0],
         )
         out_add_ref[...] = add
         out_rm_ref[...] = rm
@@ -88,9 +90,17 @@ def orset_merge_many_pallas(clocks, adds, rms, *, interpret: bool = False):
     prev_run_p = _pad_to(prev_run, 1, LANE)
     Ep, Rp = adds_p.shape[1], adds_p.shape[2]
 
+    # clocks get a singleton middle axis: a (1, 1, Rp) block's last two
+    # dims equal the array dims, which the TPU tiling rule accepts (a
+    # (1, Rp) block over (S, Rp) does not — 1 is neither divisible by 8
+    # nor equal to S)
+    clocks_p = clocks_p[:, None, :]
+    run_p = run_p[:, None, :]
+    prev_run_p = prev_run_p[:, None, :]
+
     grid = (Ep // TILE_E, S)
     clock_spec = pl.BlockSpec(
-        (1, Rp), lambda e, s: (s, 0), memory_space=pltpu.VMEM
+        (1, 1, Rp), lambda e, s: (s, 0, 0), memory_space=pltpu.VMEM
     )
     plane_spec = pl.BlockSpec(
         (1, TILE_E, Rp), lambda e, s: (s, e, 0), memory_space=pltpu.VMEM
