@@ -140,8 +140,26 @@ def main() -> int:
         assert all(np.array_equal(np.asarray(a), np.asarray(b))
                    for a, b in zip(out, whole))
         pmesh.orset_merge_sharded(mesh, *out, *out)
+        sign = (rng.random(N) < 0.4).astype(np.int8)
+        sp, sn, sv = pmesh.pncounter_fold_sharded(mesh, c0, c0, sign, actor, counter)
+        wp, wn, wv = K.pncounter_fold(c0, c0, sign, actor, counter, num_replicas=R)
+        assert np.array_equal(np.asarray(sp), np.asarray(wp))
+        assert np.array_equal(np.asarray(sn), np.asarray(wn))
+        assert int(sv) == int(wv)
+        gc, gt = pmesh.gcounter_fold_sharded(mesh, c0, actor, counter)
+        wc, wt = K.gcounter_fold(c0, actor, counter, num_replicas=R)
+        assert np.array_equal(np.asarray(gc), np.asarray(wc)) and int(gt) == int(wt)
+        Kk = 32
+        key = rng.integers(0, Kk, N).astype(np.int32)
+        hi = rng.integers(0, 4, N).astype(np.int32)
+        lo = rng.integers(0, 100, N).astype(np.int32)
+        val = rng.integers(0, 50, N).astype(np.int32)
+        sw = pmesh.lww_fold_sharded(mesh, key, hi, lo, actor, val, num_keys=Kk)
+        ww = K.lww_fold(key, hi, lo, actor, val, num_keys=Kk)
+        assert all(np.array_equal(np.asarray(a), np.asarray(b))
+                   for a, b in zip(sw, ww))
 
-    check("shard_map fold/merge (1x1 mesh)", sharded)
+    check("shard_map folds (orset/counters/lww, 1x1 mesh)", sharded)
 
     if failures:
         print(f"\n{len(failures)} kernel(s) FAILED on this hardware: {failures}")

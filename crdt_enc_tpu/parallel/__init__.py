@@ -6,20 +6,26 @@ from .distributed import (
     replicate,
 )
 from .mesh import (
+    gcounter_fold_sharded,
+    lww_fold_sharded,
     make_mesh,
     orset_fold_sharded,
     orset_merge_sharded,
     pad_rows_for_mesh,
+    pncounter_fold_sharded,
 )
 
 __all__ = [
     "TpuAccelerator",
+    "gcounter_fold_sharded",
     "global_op_batch",
     "initialize",
+    "lww_fold_sharded",
     "make_mesh",
     "make_multihost_mesh",
     "orset_fold_sharded",
     "orset_merge_sharded",
     "pad_rows_for_mesh",
+    "pncounter_fold_sharded",
     "replicate",
 ]

@@ -161,3 +161,92 @@ def pad_rows_for_mesh(cols, dp: int, num_replicas: int):
     n = len(cols.kind)
     target = ((n + dp - 1) // dp) * dp
     return K.pad_orset_rows(cols, target, num_replicas)
+
+
+# ---- counters -------------------------------------------------------------
+
+
+def pncounter_fold_sharded(mesh: Mesh, p0, n0, sign, actor, counter):
+    """PN-Counter fold with op rows sharded over ``dp`` (pad row count to
+    a dp multiple with ``actor == R`` sentinels first).  The (R,) planes
+    are replicated — they are tiny next to the batch — and the cross-
+    device combine is one ``pmax``, the same shape as the ORSet fold's."""
+    R = len(p0)
+    dp = mesh.shape["dp"]
+    if len(sign) % dp:
+        raise ValueError(f"pad first: rows {len(sign)} % dp {dp}")
+
+    def body(p0, n0, sign, actor, counter):
+        p, n, _ = K.pncounter_fold(
+            jnp.zeros_like(p0), jnp.zeros_like(n0), sign, actor, counter,
+            num_replicas=R,
+        )
+        p = jnp.maximum(p0, jax.lax.pmax(p, "dp"))
+        n = jnp.maximum(n0, jax.lax.pmax(n, "dp"))
+        return p, n, jnp.sum(p.astype(jnp.int64)) - jnp.sum(n.astype(jnp.int64))
+
+    fold = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp"), P("dp")),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return fold(p0, n0, sign, actor, counter)
+
+
+def gcounter_fold_sharded(mesh: Mesh, clock0, actor, counter):
+    """G-Counter fold sharded over ``dp`` (see pncounter_fold_sharded)."""
+    sign = np.zeros(len(actor), np.int8)
+    p, _, total = pncounter_fold_sharded(
+        mesh, clock0, jnp.zeros_like(jnp.asarray(clock0)), sign, actor, counter
+    )
+    return p, total  # n-plane is zero, so the pn value IS the sum
+
+
+# ---- LWW ------------------------------------------------------------------
+
+
+def lww_fold_sharded(mesh: Mesh, key, ts_hi, ts_lo, actor, value, *, num_keys: int):
+    """LWW-map fold with write rows sharded over ``dp``.
+
+    Each device selects its shard's per-key winners (``lww_fold``), then
+    the winner tables combine across ``dp`` with the same lexicographic
+    cascade, evaluated on an ``all_gather`` of the (K,)-sized tables —
+    dense per-key state moves once, rows never do (the data-parallel
+    shape again).  Row count must divide dp (pad with ``key == num_keys``
+    sentinel rows)."""
+    Kk = num_keys
+    dp = mesh.shape["dp"]
+    if len(key) % dp:
+        raise ValueError(f"pad first: rows {len(key)} % dp {dp}")
+
+    def body(key, ts_hi, ts_lo, actor, value):
+        local = K.lww_fold(key, ts_hi, ts_lo, actor, value, num_keys=Kk)
+        # gather every shard's winner table ((dp, K) per column) and
+        # re-select through the SAME canonical cascade: winners become
+        # dp·K candidate rows for one more lww_fold — absent winners take
+        # the key == K padding sentinel, exactly the lww_fold_into pattern
+        g_hi, g_lo, g_actor, g_value, g_present = (
+            jax.lax.all_gather(x, "dp") for x in local
+        )
+        cand_key = jnp.where(
+            g_present, jnp.arange(Kk, dtype=key.dtype)[None, :], Kk
+        )
+        return K.lww_fold(
+            cand_key.reshape(-1),
+            g_hi.reshape(-1),
+            g_lo.reshape(-1),
+            g_actor.reshape(-1),
+            g_value.reshape(-1),
+            num_keys=Kk,
+        )
+
+    fold = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("dp"),) * 5,
+        out_specs=(P(),) * 5,
+        check_vma=False,
+    )
+    return fold(key, ts_hi, ts_lo, actor, value)

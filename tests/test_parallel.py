@@ -120,3 +120,55 @@ def test_accelerated_core_matches_host_core():
         )
 
     asyncio.run(go())
+
+
+def test_sharded_pncounter_matches_whole():
+    import numpy as np
+
+    R, N = 24, 256
+    rng = np.random.default_rng(7)
+    actor = rng.integers(0, R + 1, N).astype(np.int32)  # incl. sentinels
+    sign = (rng.random(N) < 0.4).astype(np.int8)
+    counter = rng.integers(1, 30, N).astype(np.int32)
+    p0 = rng.integers(0, 5, R).astype(np.int32)
+    n0 = rng.integers(0, 5, R).astype(np.int32)
+
+    mesh = par.make_mesh((8, 1))
+    ps, ns, vs = par.pncounter_fold_sharded(mesh, p0, n0, sign, actor, counter)
+    pw, nw, vw = K.pncounter_fold(p0, n0, sign, actor, counter, num_replicas=R)
+    assert np.array_equal(np.asarray(ps), np.asarray(pw))
+    assert np.array_equal(np.asarray(ns), np.asarray(nw))
+    assert int(vs) == int(vw)
+
+
+def test_sharded_gcounter_matches_whole():
+    import numpy as np
+
+    R, N = 10, 128
+    rng = np.random.default_rng(8)
+    actor = rng.integers(0, R, N).astype(np.int32)
+    counter = rng.integers(1, 20, N).astype(np.int32)
+    clock0 = np.zeros(R, np.int32)
+    mesh = par.make_mesh((8, 1))
+    cs, ts = par.gcounter_fold_sharded(mesh, clock0, actor, counter)
+    cw, tw = K.gcounter_fold(clock0, actor, counter, num_replicas=R)
+    assert np.array_equal(np.asarray(cs), np.asarray(cw))
+    assert int(ts) == int(tw)
+
+
+def test_sharded_lww_matches_whole():
+    import numpy as np
+
+    Kk, N = 40, 512
+    rng = np.random.default_rng(9)
+    key = rng.integers(0, Kk + 1, N).astype(np.int32)  # incl. sentinels
+    hi = rng.integers(0, 4, N).astype(np.int32)
+    lo = rng.integers(0, 100, N).astype(np.int32)
+    actor = rng.integers(0, 16, N).astype(np.int32)
+    value = rng.integers(0, 50, N).astype(np.int32)
+
+    mesh = par.make_mesh((8, 1))
+    sharded = par.lww_fold_sharded(mesh, key, hi, lo, actor, value, num_keys=Kk)
+    whole = K.lww_fold(key, hi, lo, actor, value, num_keys=Kk)
+    for a, b in zip(sharded, whole):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
