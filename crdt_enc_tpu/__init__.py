@@ -4,6 +4,41 @@ Capability surface of chpio/crdt-enc (see SURVEY.md), rebuilt JAX-first:
 immutable content-addressed op/state files on a passively synced filesystem,
 LUKS-style layered key management, and bulk merge/compaction running as
 batched tensor folds on TPU.
+
+The primary surface re-exports lazily (PEP 562) so ``import crdt_enc_tpu``
+stays light — jax loads only when the accelerator or kernels are touched::
+
+    from crdt_enc_tpu import Core, OpenOptions, orset_adapter
 """
 
+import importlib
+
 __version__ = "0.1.0"
+
+# name -> submodule that defines it (resolved on first attribute access)
+_LAZY = {
+    "Core": "core",
+    "CoreError": "core",
+    "OpenOptions": "core",
+    "empty_adapter": "core",
+    "gcounter_adapter": "core",
+    "lwwmap_adapter": "core",
+    "mvreg_adapter": "core",
+    "orset_adapter": "core",
+    "pncounter_adapter": "core",
+    "TpuAccelerator": "parallel",
+    "canonical_bytes": "models",
+}
+
+__all__ = ["__version__", *sorted(_LAZY)]
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
