@@ -191,10 +191,73 @@ class FsStorage(Storage):
                 continue  # foreign junk in the synced dir is not ours to judge
         return sorted(a for a in actors if len(a) == 16)
 
+    # One C++ call scans/reads a whole dense per-actor run (SURVEY.md §2.2:
+    # the bulk load path gets a native reader) — per-file Python open/read
+    # costs ~10-20µs of interpreter overhead, which dominates at
+    # compaction scale.  Each round is capped in files AND bytes so one
+    # gigantic log never demands an unbounded flat buffer; the loop
+    # continues where the previous round stopped.
+    NATIVE_SCAN_BATCH = 65_536
+    NATIVE_SCAN_BYTES = 256 << 20
+
+    def _scan_native(self, actor: Actor, first: int):
+        """Dense scan via the native reader; None → Python fallback."""
+        import ctypes
+
+        import numpy as np
+
+        from .. import native
+
+        try:
+            lib = native.load()
+            d = self._ops_dir(actor).encode()
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            out: list[tuple[Actor, int, bytes]] = []
+            v = first
+            while True:
+                sizes = np.zeros(self.NATIVE_SCAN_BATCH, np.int64)
+                n = int(lib.scan_op_sizes(
+                    d, v, self.NATIVE_SCAN_BATCH, sizes.ctypes.data_as(i64p)
+                ))
+                if n <= 0:
+                    return out
+                scanned = n
+                sizes = sizes[:n]
+                # byte cap: shrink this round to the prefix that fits (but
+                # always take at least one file so progress is guaranteed)
+                cum = np.cumsum(sizes)
+                if cum[-1] > self.NATIVE_SCAN_BYTES:
+                    n = max(1, int(np.searchsorted(cum, self.NATIVE_SCAN_BYTES, "right")))
+                    sizes = sizes[:n]
+                offsets = np.zeros(n, np.int64)
+                np.cumsum(sizes[:-1], out=offsets[1:])
+                buf = np.empty(int(sizes.sum()), np.uint8)
+                got = lib.read_op_files(
+                    d, v, n,
+                    offsets.ctypes.data_as(i64p),
+                    sizes.ctypes.data_as(i64p),
+                    buf.ctypes.data_as(native.u8p),
+                )
+                if got != n:
+                    return None  # raced the sync tool — let Python retry
+                for i in range(n):
+                    lo = int(offsets[i])
+                    out.append(
+                        (actor, v + i, buf[lo : lo + int(sizes[i])].tobytes())
+                    )
+                v += n
+                if scanned < self.NATIVE_SCAN_BATCH and n == scanned:
+                    return out
+        except Exception:
+            return None  # any native-path surprise → per-file Python scan
+
     async def load_ops(
         self, actor_first_versions: list[tuple[Actor, int]]
     ) -> list[tuple[Actor, int, bytes]]:
         def scan(actor: Actor, first: int) -> list[tuple[Actor, int, bytes]]:
+            native_out = self._scan_native(actor, first)
+            if native_out is not None:
+                return native_out
             d = self._ops_dir(actor)
             out = []
             v = first

@@ -12,16 +12,22 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "build", "libcrdtnative.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
+_load_error: Exception | None = None  # cached: never retry a failed build per call
 
 u8p = ctypes.POINTER(ctypes.c_uint8)
 u64p = ctypes.POINTER(ctypes.c_uint64)
 
 
 def load() -> ctypes.CDLL:
-    global _lib
+    global _lib, _load_error
     with _lock:
         if _lib is not None:
             return _lib
+        if _load_error is not None:
+            # a failed build is permanent for this process — callers on hot
+            # paths (e.g. the fs op scan) probe per call and must not spawn
+            # a failing `make` subprocess every time
+            raise _load_error
         # always invoke make: an incremental no-op when fresh, and source
         # edits never silently run stale native code.  A file lock serializes
         # concurrent processes (the in-process _lock can't) so one never
@@ -36,12 +42,15 @@ def load() -> ctypes.CDLL:
                     capture_output=True,
                     text=True,
                 )
-            except subprocess.CalledProcessError as e:
-                raise RuntimeError(
-                    f"native build failed (exit {e.returncode}):\n"
-                    f"{e.stdout}\n{e.stderr}"
-                ) from e
-            lib = ctypes.CDLL(_SO)
+                lib = ctypes.CDLL(_SO)
+            except Exception as e:
+                if isinstance(e, subprocess.CalledProcessError):
+                    e = RuntimeError(
+                        f"native build failed (exit {e.returncode}):\n"
+                        f"{e.stdout}\n{e.stderr}"
+                    )
+                _load_error = e
+                raise e
 
         lib.hchacha20.argtypes = [u8p, u8p, u8p]
         lib.hchacha20.restype = None
@@ -83,6 +92,14 @@ def load() -> ctypes.CDLL:
         lib.counter_decode.restype = ctypes.c_int64
 
         i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.scan_op_sizes.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p
+        ]
+        lib.scan_op_sizes.restype = ctypes.c_int64
+        lib.read_op_files.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, u8p
+        ]
+        lib.read_op_files.restype = ctypes.c_int64
         lib.orset_count_rows_batch.argtypes = [
             u8p, u64p, u64p, ctypes.c_uint64, i64p
         ]

@@ -1,0 +1,77 @@
+// Bulk op-file reader: the C++ load path for dense per-actor op logs.
+//
+// An op-log scan reads remote/ops/<actor>/<N> for N = first, first+1, …
+// until the first missing file (the dense-version contract,
+// crdt-enc-tokio/src/lib.rs:254-269).  Per-file Python open/read costs
+// ~10-20µs of interpreter overhead; at compaction scale (SURVEY.md §2.2:
+// "the bulk load path (1M op files) gets a C++ reader") that dwarfs the
+// I/O itself.  Two-pass protocol so ctypes needs no growable buffers:
+//
+//   pass 1  scan_op_sizes(dir, first, max)  → per-file sizes (stat loop)
+//   pass 2  read_op_files(dir, first, n, buf, offsets)  → one flat buffer
+//
+// A file that shrinks/vanishes between passes returns -1 and the caller
+// falls back to the per-file Python path (the sync tool may race us; op
+// files themselves are immutable once published).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+int path_join(char* out, size_t cap, const char* dir, int64_t version) {
+  int n = snprintf(out, cap, "%s/%lld", dir, (long long)version);
+  return (n > 0 && (size_t)n < cap) ? 0 : -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1: sizes of the dense run starting at `first`.  Writes up to
+// max_files sizes; returns the count of consecutive existing files.
+int64_t scan_op_sizes(const char* dir, int64_t first, int64_t max_files,
+                      int64_t* sizes_out) {
+  char path[4096];
+  int64_t n = 0;
+  for (; n < max_files; n++) {
+    if (path_join(path, sizeof(path), dir, first + n) != 0) return n;
+    struct stat st;
+    if (stat(path, &st) != 0 || !S_ISREG(st.st_mode)) return n;
+    sizes_out[n] = (int64_t)st.st_size;
+  }
+  return n;
+}
+
+// Pass 2: read n_files consecutive files into one flat buffer at the
+// given offsets (offsets[i] .. offsets[i] + sizes[i]).  Returns n_files,
+// or -1 if any file is missing or its size changed (caller falls back).
+int64_t read_op_files(const char* dir, int64_t first, int64_t n_files,
+                      const int64_t* offsets, const int64_t* sizes,
+                      uint8_t* buf) {
+  char path[4096];
+  for (int64_t i = 0; i < n_files; i++) {
+    if (path_join(path, sizeof(path), dir, first + i) != 0) return -1;
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+    int64_t want = sizes[i];
+    uint8_t* dst = buf + offsets[i];
+    int64_t got = 0;
+    while (got < want) {
+      ssize_t r = read(fd, dst + got, (size_t)(want - got));
+      if (r <= 0) { close(fd); return -1; }
+      got += r;
+    }
+    // file must end exactly where pass 1 said (immutable once published)
+    uint8_t extra;
+    if (read(fd, &extra, 1) != 0) { close(fd); return -1; }
+    close(fd);
+  }
+  return n_files;
+}
+
+}  // extern "C"

@@ -303,3 +303,47 @@ def test_rotation_vs_meta_ingestion_race_keeps_all_keys(storage_factory):
         assert c1._data.keys.get_key(kA.id) is not None
 
     run(go())
+
+
+def test_native_op_scan_matches_python(tmp_path):
+    """The C++ bulk op reader must return exactly what the per-file
+    Python scan returns, including partial (first > 1) scans."""
+    from crdt_enc_tpu.backends.fs import FsStorage
+
+    async def go():
+        s = FsStorage(str(tmp_path / "l"), str(tmp_path / "remote"))
+        actor = b"\x01" * 16
+        blobs = [bytes([i]) * (i * 37 + 1) for i in range(12)]
+        for v, b in enumerate(blobs, start=1):
+            await s.store_ops(actor, v, b)
+        for first in (1, 5, 13):
+            native = s._scan_native(actor, first)
+            assert native is not None
+            expect = [
+                (actor, v, blobs[v - 1])
+                for v in range(first, len(blobs) + 1)
+            ]
+            assert native == expect
+            loaded = await s.load_ops([(actor, first)])
+            assert loaded == expect
+
+    run(go())
+
+
+def test_native_op_scan_byte_cap_rounds(tmp_path):
+    """A tiny byte cap forces many native read rounds; the result must be
+    identical to one unbounded round (progress guaranteed even when a
+    single file exceeds the cap)."""
+    from crdt_enc_tpu.backends.fs import FsStorage
+
+    async def go():
+        s = FsStorage(str(tmp_path / "l"), str(tmp_path / "remote"))
+        actor = b"\x02" * 16
+        blobs = [bytes([i]) * (200 + i) for i in range(9)]
+        for v, b in enumerate(blobs, start=1):
+            await s.store_ops(actor, v, b)
+        s.NATIVE_SCAN_BYTES = 64  # smaller than every single file
+        out = s._scan_native(actor, 1)
+        assert out == [(actor, v, blobs[v - 1]) for v in range(1, 10)]
+
+    run(go())
