@@ -1,0 +1,162 @@
+"""True end-to-end compaction wall-clock over real encrypted files.
+
+The BASELINE metric is "ops merged/sec + compaction wall-clock": this
+harness measures the REAL thing — a populated remote directory of sealed
+op files, then a fresh replica's ``open → read_remote → compact`` timed
+wall-to-wall (listing, reading, decrypting, decoding, folding, sealing the
+snapshot, GC), once with the host accelerator and once with the TPU
+accelerator against byte-identical copies of the same remote.
+
+Run:  python benchmarks/compaction_e2e.py [--files N] [--ops-per-file K]
+Prints one JSON line: end-to-end ops/sec for both accelerators and the
+speedup, plus a byte-equality check of the two compacted snapshots.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import shutil
+import sys
+import tempfile
+import time
+import uuid
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+async def build_remote(root: Path, n_writers: int, files_per_writer: int,
+                       ops_per_file: int, n_members: int) -> int:
+    """Writers populate the shared remote through the real product path."""
+    from crdt_enc_tpu.backends import FsStorage, PlainKeyCryptor, XChaChaCryptor
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    total = 0
+    for w in range(n_writers):
+        core = await Core.open(OpenOptions(
+            storage=FsStorage(str(root / f"w{w}"), str(root / "remote")),
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        ))
+        for _ in range(files_per_writer):
+            def build(s, w=w):
+                ops = []
+                for j in range(ops_per_file):
+                    m = (total + j * 7 + w) % n_members
+                    if j % 9 == 8 and s.contains(m):
+                        ops.append(s.rm_ctx(m))
+                    else:
+                        op = s.add_ctx(core.actor_id, m)
+                        ops.append(op)
+                    s.apply(ops[-1])
+                return ops
+            ops = await core.update(build)
+            total += len(ops)
+    return total
+
+
+async def timed_compact(root: Path, remote: Path, accel) -> tuple[float, bytes]:
+    from crdt_enc_tpu.backends import FsStorage, PlainKeyCryptor, XChaChaCryptor
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.models import canonical_bytes
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    kw = {"accelerator": accel} if accel is not None else {}
+    t0 = time.perf_counter()
+    core = await Core.open(OpenOptions(
+        storage=FsStorage(str(root), str(remote)),
+        cryptor=XChaChaCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=orset_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=True,
+        **kw,
+    ))
+    await core.compact()
+    wall = time.perf_counter() - t0
+    return wall, core.with_state(canonical_bytes)
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--writers", type=int, default=32)
+    ap.add_argument("--files", type=int, default=64, help="files per writer")
+    ap.add_argument("--ops-per-file", type=int, default=48)
+    ap.add_argument("--members", type=int, default=512)
+    args = ap.parse_args()
+
+    import crdt_enc_tpu
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.utils import trace
+
+    # persistent compile cache: short-lived compaction jobs must not pay
+    # the tens-of-seconds TPU compile on every run (first run still does)
+    cache = crdt_enc_tpu.enable_compilation_cache()
+    log(f"jax compilation cache: {cache}")
+
+    base = Path(tempfile.mkdtemp(prefix="compact-e2e-"))
+    log(f"building remote: {args.writers} writers x {args.files} files "
+        f"x {args.ops_per_file} ops …")
+    total = await build_remote(
+        base, args.writers, args.files, args.ops_per_file, args.members
+    )
+    n_files = args.writers * args.files
+    log(f"remote ready: {n_files} op files, {total} ops")
+
+    # byte-identical remote copies: each compaction consumes (GCs) its
+    # remote, so every measurement needs a fresh copy.  The TPU path runs
+    # twice — the first pays per-process jit tracing (compiles come from
+    # the persistent cache) and warms it; the second is the steady state a
+    # long-lived compactor sees.  Both are reported.
+    remote_host = base / "remote"
+    remote_tpu_cold = base / "remote-tpu-cold"
+    remote_tpu_warm = base / "remote-tpu-warm"
+    shutil.copytree(remote_host, remote_tpu_cold)
+    shutil.copytree(remote_host, remote_tpu_warm)
+
+    wall_host, state_host = await timed_compact(
+        base / "reader-host", remote_host, None
+    )
+    log(f"host compact: {wall_host:.2f}s -> {total / wall_host:,.0f} ops/s e2e")
+
+    wall_cold, state_cold = await timed_compact(
+        base / "reader-tpu-cold", remote_tpu_cold, TpuAccelerator()
+    )
+    log(f"tpu  compact (cold process): {wall_cold:.2f}s")
+    trace.reset()
+    wall_tpu, state_tpu = await timed_compact(
+        base / "reader-tpu", remote_tpu_warm, TpuAccelerator()
+    )
+    log(f"tpu  compact (warm): {wall_tpu:.2f}s -> {total / wall_tpu:,.0f} ops/s e2e")
+    log(trace.report())
+
+    equal = state_host == state_tpu == state_cold
+    shutil.rmtree(base, ignore_errors=True)
+    print(json.dumps({
+        "metric": "compaction_e2e_ops_per_sec",
+        "n_files": n_files,
+        "n_ops": total,
+        "host_wall_s": round(wall_host, 3),
+        "tpu_wall_s": round(wall_tpu, 3),
+        "tpu_cold_wall_s": round(wall_cold, 3),
+        "value": round(total / wall_tpu, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(wall_host / wall_tpu, 2),
+        "byte_equal": bool(equal),
+    }))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

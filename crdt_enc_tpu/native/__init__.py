@@ -32,93 +32,100 @@ def load() -> ctypes.CDLL:
         # edits never silently run stale native code.  A file lock serializes
         # concurrent processes (the in-process _lock can't) so one never
         # dlopens a half-linked .so.
-        os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)
-        with open(os.path.join(_HERE, "build", ".lock"), "w") as lk:
-            fcntl.flock(lk, fcntl.LOCK_EX)
-            try:
-                subprocess.run(
-                    ["make", "-C", _HERE],
-                    check=True,
-                    capture_output=True,
-                    text=True,
-                )
-                lib = ctypes.CDLL(_SO)
-            except Exception as e:
-                if isinstance(e, subprocess.CalledProcessError):
-                    e = RuntimeError(
+        try:
+            os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)
+            with open(os.path.join(_HERE, "build", ".lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                try:
+                    subprocess.run(
+                        ["make", "-C", _HERE],
+                        check=True,
+                        capture_output=True,
+                        text=True,
+                    )
+                except subprocess.CalledProcessError as e:
+                    raise RuntimeError(
                         f"native build failed (exit {e.returncode}):\n"
                         f"{e.stdout}\n{e.stderr}"
-                    )
-                _load_error = e
-                raise e
-
-        lib.hchacha20.argtypes = [u8p, u8p, u8p]
-        lib.hchacha20.restype = None
-        for name in ("chacha20poly1305_encrypt", "xchacha20poly1305_encrypt"):
-            fn = getattr(lib, name)
-            fn.argtypes = [
-                u8p, u8p, u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p
-            ]
-            fn.restype = None
-        for name in ("chacha20poly1305_decrypt", "xchacha20poly1305_decrypt"):
-            fn = getattr(lib, name)
-            fn.argtypes = [
-                u8p, u8p, u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p
-            ]
-            fn.restype = ctypes.c_int
-        lib.xchacha20poly1305_decrypt_batch.argtypes = [
-            u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p, u64p, u8p
-        ]
-        lib.xchacha20poly1305_decrypt_batch.restype = ctypes.c_int
-        lib.xchacha20poly1305_decrypt_batch_mt.argtypes = [
-            u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p, u64p, u8p,
-            ctypes.c_int,
-        ]
-        lib.xchacha20poly1305_decrypt_batch_mt.restype = ctypes.c_int
-
-        lib.orset_count_rows.argtypes = [u8p, ctypes.c_uint64]
-        lib.orset_count_rows.restype = ctypes.c_int64
-        lib.orset_decode.argtypes = [
-            u8p, ctypes.c_uint64, u8p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_int8), u64p, u64p,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.orset_decode.restype = ctypes.c_int64
-        lib.counter_decode.argtypes = [
-            u8p, ctypes.c_uint64, u8p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_int8),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.counter_decode.restype = ctypes.c_int64
-
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        lib.scan_op_sizes.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p
-        ]
-        lib.scan_op_sizes.restype = ctypes.c_int64
-        lib.read_op_files.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, u8p
-        ]
-        lib.read_op_files.restype = ctypes.c_int64
-        lib.orset_count_rows_batch.argtypes = [
-            u8p, u64p, u64p, ctypes.c_uint64, i64p
-        ]
-        lib.orset_count_rows_batch.restype = ctypes.c_int64
-        lib.orset_decode_batch.argtypes = [
-            u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64, i64p,
-            ctypes.POINTER(ctypes.c_int8), u64p, u64p,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.orset_decode_batch.restype = ctypes.c_int64
-        lib.counter_decode_batch.argtypes = [
-            u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_int8),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.counter_decode_batch.restype = ctypes.c_int64
+                    ) from e
+                lib = ctypes.CDLL(_SO)
+            _bind(lib)
+        except Exception as e:
+            # cache ANY load failure (build, dlopen, missing symbol): hot
+            # paths probe per call and must never re-spawn make
+            _load_error = e
+            raise
 
         _lib = lib
         return lib
+
+
+def _bind(lib) -> None:
+    lib.hchacha20.argtypes = [u8p, u8p, u8p]
+    lib.hchacha20.restype = None
+    for name in ("chacha20poly1305_encrypt", "xchacha20poly1305_encrypt"):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            u8p, u8p, u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p
+        ]
+        fn.restype = None
+    for name in ("chacha20poly1305_decrypt", "xchacha20poly1305_decrypt"):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            u8p, u8p, u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p
+        ]
+        fn.restype = ctypes.c_int
+    lib.xchacha20poly1305_decrypt_batch.argtypes = [
+        u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p, u64p, u8p
+    ]
+    lib.xchacha20poly1305_decrypt_batch.restype = ctypes.c_int
+    lib.xchacha20poly1305_decrypt_batch_mt.argtypes = [
+        u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p, u64p, u8p,
+        ctypes.c_int,
+    ]
+    lib.xchacha20poly1305_decrypt_batch_mt.restype = ctypes.c_int
+
+    lib.orset_count_rows.argtypes = [u8p, ctypes.c_uint64]
+    lib.orset_count_rows.restype = ctypes.c_int64
+    lib.orset_decode.argtypes = [
+        u8p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int8), u64p, u64p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.orset_decode.restype = ctypes.c_int64
+    lib.counter_decode.argtypes = [
+        u8p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.counter_decode.restype = ctypes.c_int64
+
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.scan_op_sizes.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p
+    ]
+    lib.scan_op_sizes.restype = ctypes.c_int64
+    lib.read_op_files.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, u8p
+    ]
+    lib.read_op_files.restype = ctypes.c_int64
+    lib.orset_count_rows_batch.argtypes = [
+        u8p, u64p, u64p, ctypes.c_uint64, i64p
+    ]
+    lib.orset_count_rows_batch.restype = ctypes.c_int64
+    lib.orset_decode_batch.argtypes = [
+        u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64, i64p,
+        ctypes.POINTER(ctypes.c_int8), u64p, u64p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.orset_decode_batch.restype = ctypes.c_int64
+    lib.counter_decode_batch.argtypes = [
+        u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.counter_decode_batch.restype = ctypes.c_int64
+
 
 
 def in_ptr(b):

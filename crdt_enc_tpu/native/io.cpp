@@ -14,6 +14,7 @@
 // falls back to the per-file Python path (the sync tool may race us; op
 // files themselves are immutable once published).
 
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -63,12 +64,17 @@ int64_t read_op_files(const char* dir, int64_t first, int64_t n_files,
     int64_t got = 0;
     while (got < want) {
       ssize_t r = read(fd, dst + got, (size_t)(want - got));
+      if (r < 0 && errno == EINTR) continue;  // signal mid-read: retry
       if (r <= 0) { close(fd); return -1; }
       got += r;
     }
     // file must end exactly where pass 1 said (immutable once published)
     uint8_t extra;
-    if (read(fd, &extra, 1) != 0) { close(fd); return -1; }
+    ssize_t tail;
+    do {
+      tail = read(fd, &extra, 1);
+    } while (tail < 0 && errno == EINTR);
+    if (tail != 0) { close(fd); return -1; }
     close(fd);
   }
   return n_files;
