@@ -203,3 +203,47 @@ def test_streamed_dense_fold_matches_unstreamed():
     assert canonical_bytes(streamed) == canonical_bytes(plain)
     assert canonical_bytes(streamed) == canonical_bytes(host)
     assert canonical_bytes(streamed) == canonical_bytes(final)
+
+
+def test_sparse_fold_property_random_histories():
+    """Hypothesis sweep: sparse ≡ host from arbitrary base states and op
+    tails (the fixed-seed tests above pin a handful of histories; this
+    pins the space)."""
+    from hypothesis import given, settings, strategies as st
+
+    script = st.lists(
+        st.tuples(
+            st.integers(0, len(ACTORS) - 1),
+            st.sampled_from(["add", "rm"]),
+            st.integers(0, 9),
+        ),
+        max_size=25,
+    )
+
+    def run_script(s, state=None):
+        state = state if state is not None else ORSet()
+        ops = []
+        for actor_i, kind, member in s:
+            if kind == "add":
+                op = state.add_ctx(ACTORS[actor_i], member)
+            else:
+                op = state.rm_ctx(member)
+                if op.ctx.is_empty():
+                    continue
+            state.apply(op)
+            ops.append(op)
+        return state, ops
+
+    @settings(max_examples=60, deadline=None)
+    @given(script, script)
+    def inner(script_a, script_b):
+        base, _ = run_script(script_a)
+        base_host = ORSet.from_obj(base.to_obj())
+        base_sparse = ORSet.from_obj(base.to_obj())
+        host2, ops = run_script(script_b, base_host)
+        if not ops:
+            return
+        s = sparse_accel().fold_ops(base_sparse, list(ops))
+        assert canonical_bytes(s) == canonical_bytes(host2)
+
+    inner()
