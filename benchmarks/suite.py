@@ -430,19 +430,23 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
     n_ops = sum(len(codec.unpack(p)) for p in plain[:n_host_files])
     log(f"  streaming: {n_files} files, {len(headers)} headers")
 
-    # ---- single-core host baseline: sequential decrypt → decode → apply
-    state = ORSet()
-    t0 = time.perf_counter()
-    for blob in payloads[:n_host_files]:
-        raw = decrypt_blob(key, blob)
-        for o in codec.unpack(raw):
-            if o[0] == 0:
-                state.apply(AddOp(o[1], Dot.from_obj(o[2])))
-            else:
-                state.apply(RmOp(o[1], VClock.from_obj(o[2])))
-    for h in headers:
-        MVReg.from_obj(codec.unpack(decrypt_blob(key, h)))
-    t_host = time.perf_counter() - t0
+    # ---- single-core host baseline: sequential decrypt → decode → apply,
+    # best of `iters` passes (single-pass timing showed 3x run-to-run
+    # variance from machine load; every other config is best-of too)
+    t_host = float("inf")
+    for _ in range(max(iters, 2)):
+        state = ORSet()
+        t0 = time.perf_counter()
+        for blob in payloads[:n_host_files]:
+            raw = decrypt_blob(key, blob)
+            for o in codec.unpack(raw):
+                if o[0] == 0:
+                    state.apply(AddOp(o[1], Dot.from_obj(o[2])))
+                else:
+                    state.apply(RmOp(o[1], VClock.from_obj(o[2])))
+        for h in headers:
+            MVReg.from_obj(codec.unpack(decrypt_blob(key, h)))
+        t_host = min(t_host, time.perf_counter() - t0)
     host_rate = n_ops / t_host
 
     # ---- streaming pipeline: the PRODUCT bulk path — threaded batch
