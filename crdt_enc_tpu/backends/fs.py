@@ -24,6 +24,7 @@ the dense version scan would find it.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import uuid
 
@@ -32,6 +33,10 @@ from ..models.vclock import Actor
 from .memory import content_name
 
 FS_CONCURRENCY = 32  # reference buffer_unordered(32), crdt-enc-tokio lib.rs:112
+
+logger = logging.getLogger("crdt_enc_tpu.fs")
+
+_warned_native_scan = False  # the no-toolchain fallback warns once, not per scan
 
 
 def _fsync_dir(path: str) -> None:
@@ -201,26 +206,35 @@ class FsStorage(Storage):
     NATIVE_SCAN_BYTES = 256 << 20
 
     def _scan_native(self, actor: Actor, first: int):
-        """Dense scan via the native reader; None → Python fallback."""
+        """Dense scan via the native reader.
+
+        Returns ``None`` (native path unavailable → Python scans from
+        ``first``) or ``(files, resume_v)`` where ``resume_v`` is None for a
+        completed run, or the version the Python scan should continue from —
+        the start of a round whose bulk read failed (``read_op_files``
+        reports only -1, so the whole round is re-read; it is bounded by the
+        batch/byte caps).  The per-file re-scan then distinguishes a benign
+        race (file gone → clean dense end) from a real defect (file present
+        but unreadable → loud error), so neither case is masked."""
         import ctypes
 
         import numpy as np
 
         from .. import native
 
+        out: list[tuple[Actor, int, bytes]] = []
+        v = first
         try:
             lib = native.load()
             d = self._ops_dir(actor).encode()
             i64p = ctypes.POINTER(ctypes.c_int64)
-            out: list[tuple[Actor, int, bytes]] = []
-            v = first
             while True:
                 sizes = np.zeros(self.NATIVE_SCAN_BATCH, np.int64)
                 n = int(lib.scan_op_sizes(
                     d, v, self.NATIVE_SCAN_BATCH, sizes.ctypes.data_as(i64p)
                 ))
                 if n <= 0:
-                    return out
+                    return out, None
                 scanned = n
                 sizes = sizes[:n]
                 # byte cap: shrink this round to the prefix that fits (but
@@ -239,28 +253,56 @@ class FsStorage(Storage):
                     buf.ctypes.data_as(native.u8p),
                 )
                 if got != n:
-                    return None  # raced the sync tool — let Python retry
-                for i in range(n):
-                    lo = int(offsets[i])
-                    out.append(
-                        (actor, v + i, buf[lo : lo + int(sizes[i])].tobytes())
+                    # a file in this round shrank/vanished/errored between
+                    # the passes; keep every completed round and let the
+                    # per-file scan re-probe this one for the exact cause
+                    logger.debug(
+                        "native bulk read raced at actor %s v%d; "
+                        "re-probing round per-file", actor.hex(), v,
                     )
+                    return out, v
+                # round-local accumulation: out/v must stay consistent even
+                # if an append fails mid-round (the except path resumes at v)
+                round_files = [
+                    (
+                        actor,
+                        v + i,
+                        buf[int(offsets[i]) : int(offsets[i]) + int(sizes[i])].tobytes(),
+                    )
+                    for i in range(n)
+                ]
+                out.extend(round_files)
                 v += n
                 if scanned < self.NATIVE_SCAN_BATCH and n == scanned:
-                    return out
+                    return out, None
         except Exception:
-            return None  # any native-path surprise → per-file Python scan
+            # fall back to the per-file Python scan, but not silently — a
+            # failure here on every load would mask a real native-path bug.
+            # The expected permanent case (no C toolchain: native.load()
+            # re-raises its cached build error per call) warns only once.
+            global _warned_native_scan
+            if not _warned_native_scan:
+                _warned_native_scan = True
+                logger.warning(
+                    "native op scan unavailable; using per-file scans "
+                    "(logged once)", exc_info=True,
+                )
+            else:
+                logger.debug("native op scan failed", exc_info=True)
+            return (out, v) if out else None
 
     async def load_ops(
         self, actor_first_versions: list[tuple[Actor, int]]
     ) -> list[tuple[Actor, int, bytes]]:
         def scan(actor: Actor, first: int) -> list[tuple[Actor, int, bytes]]:
-            native_out = self._scan_native(actor, first)
-            if native_out is not None:
-                return native_out
+            res = self._scan_native(actor, first)
+            if res is None:
+                out, v = [], first
+            else:
+                out, v = res
+                if v is None:
+                    return out
             d = self._ops_dir(actor)
-            out = []
-            v = first
             while True:
                 raw = _read_file(os.path.join(d, str(v)))
                 if raw is None:

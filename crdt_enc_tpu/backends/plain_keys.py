@@ -44,14 +44,33 @@ class PlainKeyCryptor(KeyCryptor):
         """Hook: decrypt a Keys blob (identity here)."""
         return vb.content
 
+    def _trust_epoch(self):
+        """Hook: a value that changes whenever ``_unprotect`` learns to open
+        blobs it previously could not (e.g. a grown recipient roster).
+        Backends with monotone trust growth return something comparable so
+        ``set_remote_meta`` can re-decode to a fixpoint; the identity
+        backend's trust never changes."""
+        return None
+
     async def set_remote_meta(self, reg: MVReg) -> None:
         """Converged key metadata arrived: fold into our register, decode the
-        Keys CRDT, install on the core (gpgme lib.rs:79-105)."""
+        Keys CRDT, install on the core (gpgme lib.rs:79-105).
+
+        Decoding runs to a trust fixpoint: one register value's roster may
+        introduce the identity that signed ANOTHER concurrent value, and
+        MVReg iteration order is arbitrary — a single pass would tolerate-skip
+        the not-yet-trusted value and silently drop its key material (e.g. a
+        rotated latest key).  Trust growth is monotone, so re-running the
+        decode whenever a pass grew trust terminates."""
         self._reg.merge(reg)
-        keys = await decode_version_bytes_mvreg(
-            self._reg, self.SUPPORTED_META_VERSIONS, Keys,
-            transform=self._unprotect, tolerate=self.DECODE_TOLERATES,
-        )
+        while True:
+            epoch = self._trust_epoch()
+            keys = await decode_version_bytes_mvreg(
+                self._reg, self.SUPPORTED_META_VERSIONS, Keys,
+                transform=self._unprotect, tolerate=self.DECODE_TOLERATES,
+            )
+            if self._trust_epoch() == epoch:
+                break
         if keys is not None and self._core is not None:
             self._core.set_keys(keys)
 

@@ -263,6 +263,11 @@ class X25519KeyCryptor(PlainKeyCryptor):
                     self._recipients.append(pub)
         return clear
 
+    def _trust_epoch(self):
+        # roster growth is monotone (append-only unless pinned), so the
+        # length is a valid fixpoint epoch for set_remote_meta's re-decode
+        return len(self._recipients)
+
     # A register may hold concurrent values some of which this replica
     # cannot open (e.g. one written by a stale process sealing only to
     # itself).  Readable values must still decode — skipping the

@@ -19,7 +19,7 @@ from dataclasses import dataclass, field
 
 from ..models import MVReg, ORSet
 from ..models.vclock import Actor
-from ..utils import VersionBytes
+from ..utils import VersionBytes, codec
 
 
 @dataclass(frozen=True)
@@ -56,12 +56,32 @@ class Keys:
 
     latest: MVReg = field(default_factory=MVReg)
     keys: ORSet = field(default_factory=ORSet)
+    # id → Key lookup index, built lazily and invalidated by every mutation
+    # that goes through this class.  ``get_key`` is called per key-group per
+    # bulk ingest and per sealed blob open; without the index each call
+    # re-sorts the whole rotation history (O(K log K · msgpack)).
+    _index: dict | None = field(
+        default=None, repr=False, compare=False, init=False
+    )
+
+    def _key_index(self) -> dict:
+        if self._index is None:
+            by_id: dict[bytes, tuple] = {}
+            for m in self.keys.entries:
+                kid = bytes(m[0])
+                prev = by_id.get(kid)
+                # ids are unique in practice (material is immutable per id,
+                # reference key_cryptor.rs:85-139); if storage ever presents
+                # duplicates, keep the canonical-order winner deterministically
+                if prev is None or codec.pack(m) < codec.pack(prev):
+                    by_id[kid] = m
+            self._index = {
+                kid: Key.from_member_obj(m) for kid, m in by_id.items()
+            }
+        return self._index
 
     def get_key(self, kid: bytes) -> Key | None:
-        for m in self.keys.members():
-            if bytes(m[0]) == kid:
-                return Key.from_member_obj(m)
-        return None
+        return self._key_index().get(bytes(kid))
 
     def latest_key(self) -> Key | None:
         """Deterministic resolution of concurrent latest-id writes: the
@@ -80,10 +100,12 @@ class Keys:
         (key_cryptor.rs:72-82: Orswot add + MVReg write under add-ctx)."""
         self.keys.apply(self.keys.add_ctx(actor, key.member_obj()))
         self.latest.apply(self.latest.write_ctx(actor, key.id))
+        self._index = None
 
     def merge(self, other: "Keys") -> None:
         self.latest.merge(other.latest)
         self.keys.merge(other.keys)
+        self._index = None
 
     def to_obj(self):
         return {b"l": self.latest.to_obj(), b"k": self.keys.to_obj()}

@@ -53,6 +53,19 @@ logger = logging.getLogger("crdt_enc_tpu.distributed")
 _INITIALIZED = False
 
 
+def _backend_untouched() -> bool | None:
+    """Whether the XLA backend is still uninitialized: True/False when the
+    probe works, None when it cannot tell.  Probes private jax internals —
+    no public API exposes this without initializing the backend as a side
+    effect — so a jax release that moves them degrades to None rather than
+    crashing; callers decide how to act on uncertainty."""
+    bridge = getattr(getattr(jax, "_src", None), "xla_bridge", None)
+    backends = getattr(bridge, "_backends", None)
+    if backends is None:
+        return None
+    return not backends
+
+
 def _already_initialized() -> bool:
     """Probe the distributed client WITHOUT touching the XLA backend
     (``jax.process_count()`` would initialize it, after which
@@ -108,8 +121,10 @@ def initialize(
         )
         _INITIALIZED = True
         return True
-    if jax._src.xla_bridge._backends:
-        return False  # backend already up — too late to auto-detect; no-op
+    if _backend_untouched() is False:
+        return False  # backend provably up — too late to auto-detect; no-op
+    # backend untouched (or unknowable on this jax version): attempt
+    # auto-detection — the call itself degrades gracefully either way
     try:
         jax.distributed.initialize(**kwargs)
     except Exception as e:  # no pod metadata → plain single-process run

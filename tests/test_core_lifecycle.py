@@ -317,13 +317,13 @@ def test_native_op_scan_matches_python(tmp_path):
         for v, b in enumerate(blobs, start=1):
             await s.store_ops(actor, v, b)
         for first in (1, 5, 13):
-            native = s._scan_native(actor, first)
-            assert native is not None
+            files, resume = s._scan_native(actor, first)
+            assert resume is None  # run completed natively
             expect = [
                 (actor, v, blobs[v - 1])
                 for v in range(first, len(blobs) + 1)
             ]
-            assert native == expect
+            assert files == expect
             loaded = await s.load_ops([(actor, first)])
             assert loaded == expect
 
@@ -343,7 +343,75 @@ def test_native_op_scan_byte_cap_rounds(tmp_path):
         for v, b in enumerate(blobs, start=1):
             await s.store_ops(actor, v, b)
         s.NATIVE_SCAN_BYTES = 64  # smaller than every single file
-        out = s._scan_native(actor, 1)
-        assert out == [(actor, v, blobs[v - 1]) for v in range(1, 10)]
+        files, resume = s._scan_native(actor, 1)
+        assert resume is None
+        assert files == [(actor, v, blobs[v - 1]) for v in range(1, 10)]
+
+    run(go())
+
+
+def test_native_scan_race_keeps_prefix_and_reprobes(tmp_path, monkeypatch):
+    """A failed native bulk read must not discard already-read rounds; the
+    per-file scan re-probes the failed round, so a vanished file ends the
+    dense run cleanly while other files still load (advisor finding)."""
+    from crdt_enc_tpu.backends.fs import FsStorage
+
+    async def go():
+        s = FsStorage(str(tmp_path / "l"), str(tmp_path / "remote"))
+        actor = b"\x03" * 16
+        blobs = [bytes([i]) * 50 for i in range(8)]
+        for v, b in enumerate(blobs, start=1):
+            await s.store_ops(actor, v, b)
+        s.NATIVE_SCAN_BATCH = 3  # several native rounds
+
+        from crdt_enc_tpu import native
+
+        lib = native.load()
+        real_read = lib.read_op_files
+        fail_from = 4  # fail every round starting at version >= 4
+
+        def racy_read(d, first, n, offsets, sizes, buf):
+            if first >= fail_from:
+                return -1
+            return real_read(d, first, n, offsets, sizes, buf)
+
+        monkeypatch.setattr(lib, "read_op_files", racy_read)
+        files, resume = s._scan_native(actor, 1)
+        # round 1 (v1-3) succeeded natively; the failed round is handed off
+        assert files == [(actor, v, blobs[v - 1]) for v in (1, 2, 3)]
+        assert resume == 4
+        # load_ops transparently finishes per-file: full result, no loss
+        loaded = await s.load_ops([(actor, 1)])
+        assert loaded == [
+            (actor, v, blobs[v - 1]) for v in range(1, len(blobs) + 1)
+        ]
+
+    run(go())
+
+
+def test_unreadable_op_file_raises_loudly(tmp_path):
+    """A present-but-unreadable op file is a real defect, not a race: the
+    scan must raise, not silently truncate the log (reviewer finding)."""
+    import os as _os
+
+    import pytest
+
+    from crdt_enc_tpu.backends.fs import FsStorage
+
+    if _os.geteuid() == 0:
+        pytest.skip("permission bits do not bind root")
+
+    async def go():
+        s = FsStorage(str(tmp_path / "l"), str(tmp_path / "remote"))
+        actor = b"\x04" * 16
+        for v in range(1, 6):
+            await s.store_ops(actor, v, bytes([v]) * 40)
+        path = _os.path.join(s._ops_dir(actor), "3")
+        _os.chmod(path, 0)
+        try:
+            with pytest.raises(PermissionError):
+                await s.load_ops([(actor, 1)])
+        finally:
+            _os.chmod(path, 0o644)
 
     run(go())

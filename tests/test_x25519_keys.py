@@ -260,3 +260,73 @@ def test_unreadable_concurrent_value_tolerated(tmp_path):
         await c_a.update(lambda s: s.add_ctx(c_a.actor_id, b"y"))
 
     run(go())
+
+
+def test_roster_trust_growth_reaches_fixpoint(monkeypatch):
+    """Two concurrent register values: one signed by A (trusted), whose
+    roster introduces B; one signed by B carrying a rotated latest key.
+    The decode must recover B's key material REGARDLESS of MVReg value
+    order — a single-pass decode tolerate-skipped B's value whenever it
+    was processed before A's roster introduced B, silently dropping the
+    rotated latest key (advisor finding, round 1)."""
+    from crdt_enc_tpu.core.key_cryptor import Key, Keys
+    from crdt_enc_tpu.models import MVReg
+    from crdt_enc_tpu.utils import VersionBytes
+
+    priv_a, pub_a = generate_identity()
+    priv_b, pub_b = generate_identity()
+    priv_c, pub_c = generate_identity()
+    roster = [pub_a, pub_b, pub_c]
+
+    actor_a, actor_b = b"A" * 16, b"B" * 16
+    key1 = Key.new(VersionBytes(DEFAULT_DATA_VERSION_1, b"\x01" * 32))
+    key2 = Key.new(VersionBytes(DEFAULT_DATA_VERSION_1, b"\x02" * 32))
+    keys_a = Keys()
+    keys_a.insert_latest_key(actor_a, key1)
+    keys_b = Keys.from_obj(keys_a.to_obj())
+    keys_b.insert_latest_key(actor_b, key2)  # B rotated the latest key
+
+    def reg_value(keys, signer_priv):
+        blob = wrap_blob(codec.pack(keys.to_obj()), roster, signer_priv)
+        return VersionBytes(X25519KeyCryptor.META_VERSION, blob).to_obj()
+
+    reg_a, reg_b = MVReg(), MVReg()
+    reg_a.apply(reg_a.write_ctx(actor_a, reg_value(keys_a, priv_a)))
+    reg_b.apply(reg_b.write_ctx(actor_b, reg_value(keys_b, priv_b)))
+    reg_a.merge(reg_b)
+    assert len(reg_a.read().values) == 2  # genuinely concurrent
+
+    class CoreStub:
+        keys = None
+
+        def set_keys(self, keys):
+            self.keys = keys
+
+    async def decode_with_order(reverse: bool):
+        kc = X25519KeyCryptor(priv_c, [pub_a])  # trusts only A (+ itself)
+        stub = CoreStub()
+        await kc.init(stub)
+        if reverse:
+            orig_read = MVReg.read
+
+            def rev_read(self):
+                ctx = orig_read(self)
+                ctx.values = list(reversed(ctx.values))
+                return ctx
+
+            monkeypatch.setattr(MVReg, "read", rev_read)
+        try:
+            await kc.set_remote_meta(MVReg.from_obj(reg_a.to_obj()))
+        finally:
+            monkeypatch.undo()
+        return stub.keys
+
+    # both iteration orders must converge to the same full key set
+    for reverse in (False, True):
+        got = run(decode_with_order(reverse))
+        assert got is not None
+        assert got.get_key(key1.id) is not None
+        assert got.get_key(key2.id) is not None, (
+            f"rotated key lost to decode order (reverse={reverse})"
+        )
+        assert got.latest_key().id == key2.id
