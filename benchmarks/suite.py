@@ -296,6 +296,9 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
     t_host = time.perf_counter() - t0
 
     args = [jax.device_put(x) for x in (key, hi, lo, actor, value)]
+    # value domain is 0..99 rank-interned, so the (actor, value) cascades
+    # pack into one (R * V = 1M ≪ 2^31)
+    n_values = int(value.max()) + 1
 
     def make_chained(n):
         @jax.jit
@@ -311,7 +314,8 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
             def body(carry, _):
                 return (
                     K.lww_fold_into(
-                        carry, key, hi, lo, actor, value, num_keys=K_keys
+                        carry, key, hi, lo, actor, value,
+                        num_keys=K_keys, num_values=n_values,
                     ),
                     (),
                 )
@@ -330,9 +334,10 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
     # fold against the host reference
     h2 = n_host // 2
     inc = K.lww_fold_into(
-        K.lww_fold(key[:h2], hi[:h2], lo[:h2], actor[:h2], value[:h2], num_keys=K_keys),
+        K.lww_fold(key[:h2], hi[:h2], lo[:h2], actor[:h2], value[:h2],
+                   num_keys=K_keys, num_values=n_values),
         key[h2:n_host], hi[h2:n_host], lo[h2:n_host], actor[h2:n_host],
-        value[h2:n_host], num_keys=K_keys,
+        value[h2:n_host], num_keys=K_keys, num_values=n_values,
     )
     whole = K.lww_fold(
         key[:n_host], hi[:n_host], lo[:n_host], actor[:n_host], value[:n_host],

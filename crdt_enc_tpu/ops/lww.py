@@ -31,7 +31,7 @@ def ts_split(ts):
     )
 
 
-@partial(jax.jit, static_argnames=("num_keys",))
+@partial(jax.jit, static_argnames=("num_keys", "num_values"))
 def lww_fold(
     key: jax.Array,  # (N,) int32   (== num_keys ⇒ padding row)
     ts_hi: jax.Array,  # (N,) int32
@@ -40,10 +40,18 @@ def lww_fold(
     value: jax.Array,  # (N,) int32  rank-interned (tombstone included)
     *,
     num_keys: int,
+    num_values: int | None = None,
 ):
     """Per-key winner selection.  Returns ``(win_hi, win_lo, win_actor,
     win_value, present)``; ``present[k]`` is False for keys with no rows
-    (possible when folding into an existing key vocabulary)."""
+    (possible when folding into an existing key vocabulary).
+
+    ``num_values``: when given AND ``max_actor_rank * num_values +
+    num_values`` fits int32 (caller's responsibility — the accelerator and
+    benchmarks check ``R * V < 2**31``), the (actor, value) tie-breaks
+    collapse into ONE packed-rank cascade: ``av = actor * num_values +
+    value`` preserves the lexicographic order, cutting the segment-max
+    passes (the kernel's scatter-bound hot cost) from 4 to 3."""
     K = num_keys
     pad = key >= K
     key_ix = jnp.minimum(key, K - 1)
@@ -56,13 +64,44 @@ def lww_fold(
     elig = ~pad
     elig, m_hi = cascade(elig, ts_hi)
     elig, m_lo = cascade(elig, ts_lo)
-    elig, m_actor = cascade(elig, actor)
-    elig, m_value = cascade(elig, value)
-    present = m_hi > -1
+    if num_values is not None:
+        _, m_av = cascade(elig, actor * num_values + value)
+        present = m_hi > -1
+        m_actor = jnp.where(present, m_av // num_values, -1)
+        m_value = jnp.where(present, m_av % num_values, -1)
+    else:
+        elig, m_actor = cascade(elig, actor)
+        _, m_value = cascade(elig, value)
+        present = m_hi > -1
     return m_hi, m_lo, m_actor, m_value, present
 
 
-@partial(jax.jit, static_argnames=("num_keys",))
+def lww_table_wins(a: tuple, b: tuple):
+    """Elementwise: where winner-table row ``a`` beats ``b`` — present
+    beats absent; both present resolve by the (ts_hi, ts_lo, actor, value)
+    lexicographic order (the host tie-break, models/lwwmap.py)."""
+    a_hi, a_lo, a_ac, a_va, a_p = a
+    b_hi, b_lo, b_ac, b_va, b_p = b
+    gt = a_hi > b_hi
+    eq = a_hi == b_hi
+    gt = gt | (eq & (a_lo > b_lo))
+    eq = eq & (a_lo == b_lo)
+    gt = gt | (eq & (a_ac > b_ac))
+    eq = eq & (a_ac == b_ac)
+    gt = gt | (eq & (a_va > b_va))
+    return (a_p & ~b_p) | (a_p & b_p & gt)
+
+
+def lww_table_merge(a: tuple, b: tuple) -> tuple:
+    """Merge two (K,)-shaped winner tables elementwise (pure VPU work —
+    no scatters).  Ties keep ``b``, matching segment-max semantics where
+    identical tuples are indistinguishable."""
+    take_a = lww_table_wins(a, b)
+    out = tuple(jnp.where(take_a, x, y) for x, y in zip(a[:4], b[:4]))
+    return (*out, a[4] | b[4])
+
+
+@partial(jax.jit, static_argnames=("num_keys", "num_values"))
 def lww_fold_into(
     win: tuple,  # (win_hi, win_lo, win_actor, win_value, present) — (K,) each
     key: jax.Array,
@@ -72,22 +111,18 @@ def lww_fold_into(
     value: jax.Array,
     *,
     num_keys: int,
+    num_values: int | None = None,
 ):
     """Incremental fold: new rows compete against an existing winner table.
 
-    The current winners re-enter as candidate rows (absent keys as padding),
-    so ``fold_into(fold(A), B) == fold(A ++ B)`` — the LWW tie-break is a
-    total order, making the fold associative.  This is the merge step for
-    folding op batches that arrive in waves (and the data dependence the
-    benchmark's chained timing needs)."""
-    K = num_keys
-    w_hi, w_lo, w_actor, w_value, present = win
-    prev_key = jnp.where(present, jnp.arange(K, dtype=key.dtype), K)
-    return lww_fold(
-        jnp.concatenate([key, prev_key]),
-        jnp.concatenate([ts_hi, w_hi]),
-        jnp.concatenate([ts_lo, w_lo]),
-        jnp.concatenate([actor, w_actor]),
-        jnp.concatenate([value, w_value]),
-        num_keys=K,
+    The new rows fold to their own per-key winners, which then merge with
+    the existing table **elementwise** (``lww_table_merge``) — the carried
+    winners never re-enter the scatter path, so the incremental cost is
+    the new rows plus one O(K) VPU pass.  The LWW tie-break is a total
+    order, so ``fold_into(fold(A), B) == fold(A ++ B)`` (associativity) —
+    this is the merge step for folding op batches that arrive in waves."""
+    new = lww_fold(
+        key, ts_hi, ts_lo, actor, value,
+        num_keys=num_keys, num_values=num_values,
     )
+    return lww_table_merge(new, win)

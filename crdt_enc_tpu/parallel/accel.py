@@ -33,10 +33,27 @@ def _bucket(n: int, floor: int = 8) -> int:
 
 class TpuAccelerator(HostAccelerator):
     """Accelerates ORSet / G-Counter / PN-Counter / LWW-Map; anything else
-    (MVReg, EmptyCrdt, custom types) falls back to the host loops."""
+    (MVReg, EmptyCrdt, custom types) falls back to the host loops.
 
-    def __init__(self, min_device_batch: int = MIN_DEVICE_BATCH):
+    ``mesh``: an optional ``jax.sharding.Mesh`` with ``(dp, mp)`` axes
+    (``parallel.mesh.make_mesh`` / ``distributed.make_multihost_mesh``).
+    With more than one device, every fold and merge routes through the
+    sharded SPMD kernels — op rows over ``dp``, state planes over ``mp`` —
+    so ``Core.compact`` executes multi-chip, not on device 0 of a pod."""
+
+    def __init__(self, min_device_batch: int = MIN_DEVICE_BATCH, mesh=None):
         self.min_device_batch = min_device_batch
+        self.mesh = mesh
+
+    def _mesh_active(self) -> bool:
+        return self.mesh is not None and self.mesh.size > 1
+
+    def _dp(self) -> int:
+        return self.mesh.shape["dp"] if self._mesh_active() else 1
+
+    @staticmethod
+    def _round_to(n: int, mult: int) -> int:
+        return -(-n // mult) * mult
 
     # ------------------------------------------------------------- fold_ops
     def fold_ops(self, state, ops: list):
@@ -87,6 +104,14 @@ class TpuAccelerator(HostAccelerator):
         E, R = len(members), len(replicas)
         if E == 0 or R == 0:
             return state
+        if self._mesh_active():
+            # SPMD fold: rows shard over dp, planes over mp.  The mp axis is
+            # also what makes huge (E, R) planes tractable — each device
+            # holds E/mp rows — so the single-device sparse escape hatch
+            # does not apply here.
+            return self._fold_orset_sharded(
+                state, kind, member, actor, counter, members, replicas
+            )
         if self._use_sparse(E, R, n_rows):
             # vectorized host fold: in the N ≪ E·R regime the work is one
             # sort, where numpy beats the TPU's bitonic sort ~25x and no
@@ -126,6 +151,48 @@ class TpuAccelerator(HostAccelerator):
             )
         folded = K.orset_planes_to_state(
             np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+        )
+        state.clock = folded.clock
+        state.entries = folded.entries
+        state.deferred = folded.deferred
+        return state
+
+    def _fold_orset_sharded(
+        self, state: ORSet, kind, member, actor, counter, members, replicas
+    ) -> ORSet:
+        """Multi-device tail: pad rows to the dp axis and the plane member
+        axis to the mp axis, run the shard_map fold, write planes back."""
+        from . import mesh as pmesh
+
+        mesh = self.mesh
+        dp, mp = mesh.shape["dp"], mesh.shape["mp"]
+        E, R = len(members), len(replicas)
+        clock0, add0, rm0 = K.orset_state_to_planes(
+            state, members, replicas, scanned=True
+        )
+        E_pad = self._round_to(E, mp)
+        if E_pad != E:
+            z = np.zeros((E_pad - E, R), add0.dtype)
+            add0 = np.concatenate([add0, z])
+            rm0 = np.concatenate([rm0, z])
+        cols = K.OrsetColumns(
+            np.asarray(kind, np.int8),
+            np.asarray(member, np.int32),
+            np.asarray(actor, np.int32),
+            np.asarray(counter, np.int32),
+            members,
+            replicas,
+        )
+        K.pad_orset_rows(
+            cols, self._round_to(_bucket(len(cols.kind)), dp), R
+        )
+        clock, add, rm = pmesh.orset_fold_sharded(
+            mesh, clock0, add0, rm0,
+            cols.kind, cols.member, cols.actor, cols.counter,
+        )
+        folded = K.orset_planes_to_state(
+            np.asarray(clock), np.asarray(add)[:E], np.asarray(rm)[:E],
+            members, replicas,
         )
         state.clock = folded.clock
         state.entries = folded.entries
@@ -200,10 +267,9 @@ class TpuAccelerator(HostAccelerator):
         )
         return True
 
-    @staticmethod
-    def _pad_counter_cols(cols, num_replicas: int):
+    def _pad_counter_cols(self, cols, num_replicas: int):
         n = len(cols.sign)
-        padn = _bucket(n) - n
+        padn = self._round_to(_bucket(n), self._dp()) - n
         if padn:
             cols.sign = np.concatenate([cols.sign, np.zeros(padn, np.int8)])
             cols.actor = np.concatenate(
@@ -229,19 +295,32 @@ class TpuAccelerator(HostAccelerator):
         if R == 0:
             return state
         self._pad_counter_cols(cols, R)
+        sharded = self._mesh_active()
+        if sharded:
+            from . import mesh as pmesh
         if isinstance(state, PNCounter):
             p0 = K.vclock_to_dense(state.p.clock, replicas)
             n0 = K.vclock_to_dense(state.n.clock, replicas)
-            p, n, _ = K.pncounter_fold(
-                p0, n0, cols.sign, cols.actor, cols.counter, num_replicas=R
-            )
+            if sharded:
+                p, n, _ = pmesh.pncounter_fold_sharded(
+                    self.mesh, p0, n0, cols.sign, cols.actor, cols.counter
+                )
+            else:
+                p, n, _ = K.pncounter_fold(
+                    p0, n0, cols.sign, cols.actor, cols.counter, num_replicas=R
+                )
             state.p.clock = K.dense_to_vclock(np.asarray(p), replicas)
             state.n.clock = K.dense_to_vclock(np.asarray(n), replicas)
         else:
             clock0 = K.vclock_to_dense(state.clock, replicas)
-            clock, _ = K.gcounter_fold(
-                clock0, cols.actor, cols.counter, num_replicas=R
-            )
+            if sharded:
+                clock, _ = pmesh.gcounter_fold_sharded(
+                    self.mesh, clock0, cols.actor, cols.counter
+                )
+            else:
+                clock, _ = K.gcounter_fold(
+                    clock0, cols.actor, cols.counter, num_replicas=R
+                )
             state.clock = K.dense_to_vclock(np.asarray(clock), replicas)
         return state
 
@@ -257,7 +336,7 @@ class TpuAccelerator(HostAccelerator):
         if Kn == 0:
             return state
         n = len(cols.key)
-        padn = _bucket(n) - n
+        padn = self._round_to(_bucket(n), self._dp()) - n
         key_col, hi, lo, actor_col, value_col = (
             cols.key,
             cols.ts_hi,
@@ -271,9 +350,20 @@ class TpuAccelerator(HostAccelerator):
             lo = np.concatenate([lo, np.zeros(padn, np.int32)])
             actor_col = np.concatenate([actor_col, np.zeros(padn, np.int32)])
             value_col = np.concatenate([value_col, np.zeros(padn, np.int32)])
-        m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
-            key_col, hi, lo, actor_col, value_col, num_keys=Kn
-        )
+        if self._mesh_active():
+            from . import mesh as pmesh
+
+            m_hi, m_lo, m_actor, m_value, present = pmesh.lww_fold_sharded(
+                self.mesh, key_col, hi, lo, actor_col, value_col, num_keys=Kn
+            )
+        else:
+            # pack (actor, value) into one cascade when the rank product fits
+            V = len(cols.values_sorted)
+            num_values = V if len(cols.actors_sorted) * V < 2**31 else None
+            m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
+                key_col, hi, lo, actor_col, value_col,
+                num_keys=Kn, num_values=num_values,
+            )
         m_hi = np.asarray(m_hi)
         m_lo = np.asarray(m_lo)
         m_actor = np.asarray(m_actor)
@@ -289,28 +379,91 @@ class TpuAccelerator(HostAccelerator):
         )
         tomb_by_key = np.zeros(Kn, bool)
         np.maximum.at(tomb_by_key, ki[win], cols.tombstone[win])
-        for k in range(Kn):
-            if not present[k]:
-                continue
-            ts = (int(m_hi[k]) << 31) | int(m_lo[k])
-            actor = cols.actors_sorted[int(m_actor[k])]
-            tomb = bool(tomb_by_key[k])
-            value = None if tomb else cols.values_sorted[int(m_value[k])]
-            # fold against any existing entry under host tie-break rules
-            state.apply(
-                state.delete(cols.keys.items[k], ts, actor)
-                if tomb
-                else state.put(cols.keys.items[k], ts, actor, value)
+
+        # vectorized writeback: materialize all winner entries in bulk
+        # (batched .tolist() conversions, no per-key state.apply / LWWOp),
+        # then resolve against existing entries — the host tie-break runs
+        # only on actual key collisions
+        from ..models.lwwmap import _wins
+
+        idx = np.flatnonzero(present)
+        ts64 = (m_hi[idx].astype(np.int64) << 31) | m_lo[idx]
+        items = cols.keys.items
+        actors, values = cols.actors_sorted, cols.values_sorted
+        tombs = tomb_by_key[idx].tolist()
+        new_entries = {
+            items[k]: [
+                t,
+                actors[a],
+                None if tomb else values[v],
+                tomb,
+            ]
+            for k, t, a, v, tomb in zip(
+                idx.tolist(),
+                ts64.tolist(),
+                m_actor[idx].tolist(),
+                m_value[idx].tolist(),
+                tombs,
             )
+        }
+        entries = state.entries
+        if not entries:
+            state.entries = new_entries
+        else:
+            for key_obj, new in new_entries.items():
+                cur = entries.get(key_obj)
+                if cur is None or _wins(*new, *cur):
+                    entries[key_obj] = new
         return state
 
     # --------------------------------------------------------- merge_states
     def merge_states(self, state, others: list):
         if not others:
             return state
-        if isinstance(state, ORSet) and len(others) + 1 >= 3:
-            return self._merge_orsets(state, others)
+        if isinstance(state, ORSet):
+            if self._mesh_active():
+                return self._merge_orsets_sharded(state, others)
+            if len(others) + 1 >= 3:
+                return self._merge_orsets(state, others)
         return super().merge_states(state, others)
+
+    def _merge_orsets_sharded(self, state: ORSet, others: list) -> ORSet:
+        """Pairwise SPMD merges with planes sharded over mp — elementwise
+        work only, so each pair is one shard_map with no collectives."""
+        from . import mesh as pmesh
+
+        mesh = self.mesh
+        mp = mesh.shape["mp"]
+        members, replicas = K.Vocab(), K.Vocab()
+        all_states = [state] + list(others)
+        for s in all_states:
+            K.orset_scan_vocab(s, members, replicas)
+        E, R = len(members), len(replicas)
+        if E == 0 or R == 0:
+            return super().merge_states(state, others)
+        E_pad = self._round_to(E, mp)
+
+        def planes(s):
+            clock, add, rm = K.orset_state_to_planes(
+                s, members, replicas, scanned=True
+            )
+            if E_pad != E:
+                z = np.zeros((E_pad - E, R), add.dtype)
+                add = np.concatenate([add, z])
+                rm = np.concatenate([rm, z])
+            return clock, add, rm
+
+        acc = planes(state)
+        for other in others:
+            acc = pmesh.orset_merge_sharded(mesh, *acc, *planes(other))
+        clock, add, rm = (np.asarray(x) for x in acc)
+        merged = K.orset_planes_to_state(
+            clock, add[:E], rm[:E], members, replicas
+        )
+        state.clock = merged.clock
+        state.entries = merged.entries
+        state.deferred = merged.deferred
+        return state
 
     def _merge_orsets(self, state: ORSet, others: list) -> ORSet:
         members, replicas = K.Vocab(), K.Vocab()

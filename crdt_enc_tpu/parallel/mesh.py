@@ -212,10 +212,11 @@ def lww_fold_sharded(mesh: Mesh, key, ts_hi, ts_lo, actor, value, *, num_keys: i
 
     Each device selects its shard's per-key winners (``lww_fold``), then
     the winner tables combine across ``dp`` with the same lexicographic
-    cascade, evaluated on an ``all_gather`` of the (K,)-sized tables —
-    dense per-key state moves once, rows never do (the data-parallel
-    shape again).  Row count must divide dp (pad with ``key == num_keys``
-    sentinel rows)."""
+    order evaluated **elementwise** on an ``all_gather`` of the (K,)-sized
+    tables (``lww_table_merge``) — dense per-key state moves once, rows
+    never do, and the cross-shard combine never touches the scatter path.
+    Row count must divide dp (pad with ``key == num_keys`` sentinel
+    rows)."""
     Kk = num_keys
     dp = mesh.shape["dp"]
     if len(key) % dp:
@@ -224,23 +225,12 @@ def lww_fold_sharded(mesh: Mesh, key, ts_hi, ts_lo, actor, value, *, num_keys: i
     def body(key, ts_hi, ts_lo, actor, value):
         local = K.lww_fold(key, ts_hi, ts_lo, actor, value, num_keys=Kk)
         # gather every shard's winner table ((dp, K) per column) and
-        # re-select through the SAME canonical cascade: winners become
-        # dp·K candidate rows for one more lww_fold — absent winners take
-        # the key == K padding sentinel, exactly the lww_fold_into pattern
-        g_hi, g_lo, g_actor, g_value, g_present = (
-            jax.lax.all_gather(x, "dp") for x in local
-        )
-        cand_key = jnp.where(
-            g_present, jnp.arange(Kk, dtype=key.dtype)[None, :], Kk
-        )
-        return K.lww_fold(
-            cand_key.reshape(-1),
-            g_hi.reshape(-1),
-            g_lo.reshape(-1),
-            g_actor.reshape(-1),
-            g_value.reshape(-1),
-            num_keys=Kk,
-        )
+        # lex-reduce across the dp axis — pure VPU work, no re-scatter
+        g = tuple(jax.lax.all_gather(x, "dp") for x in local)
+        acc = tuple(x[0] for x in g)
+        for i in range(1, dp):
+            acc = K.lww_table_merge(tuple(x[i] for x in g), acc)
+        return acc
 
     fold = jax.shard_map(
         body,

@@ -389,29 +389,44 @@ def test_native_scan_race_keeps_prefix_and_reprobes(tmp_path, monkeypatch):
     run(go())
 
 
-def test_unreadable_op_file_raises_loudly(tmp_path):
+def test_unreadable_op_file_raises_loudly(tmp_path, monkeypatch):
     """A present-but-unreadable op file is a real defect, not a race: the
-    scan must raise, not silently truncate the log (reviewer finding)."""
+    scan must raise, not silently truncate the log (reviewer finding).
+    Unreadability is simulated by monkeypatching (chmod 0 would not bind
+    when tests run as root): the native bulk round fails, and the per-file
+    re-probe hits the open error — the exact production sequence."""
     import os as _os
 
     import pytest
 
+    import crdt_enc_tpu.backends.fs as fsmod
+    from crdt_enc_tpu import native
     from crdt_enc_tpu.backends.fs import FsStorage
-
-    if _os.geteuid() == 0:
-        pytest.skip("permission bits do not bind root")
 
     async def go():
         s = FsStorage(str(tmp_path / "l"), str(tmp_path / "remote"))
-        actor = b"\x04" * 16
+        actor = b"\x05" * 16
         for v in range(1, 6):
             await s.store_ops(actor, v, bytes([v]) * 40)
-        path = _os.path.join(s._ops_dir(actor), "3")
-        _os.chmod(path, 0)
-        try:
-            with pytest.raises(PermissionError):
-                await s.load_ops([(actor, 1)])
-        finally:
-            _os.chmod(path, 0o644)
+
+        lib = native.load()
+        real_read = lib.read_op_files
+
+        def failing_read(d, first, n, offsets, sizes, buf):
+            if first <= 3 < first + n:
+                return -1  # the unreadable file fails the whole bulk round
+            return real_read(d, first, n, offsets, sizes, buf)
+
+        real_rf = fsmod._read_file
+
+        def failing_rf(path):
+            if path.endswith(_os.sep + "3"):
+                raise PermissionError(path)
+            return real_rf(path)
+
+        monkeypatch.setattr(lib, "read_op_files", failing_read)
+        monkeypatch.setattr(fsmod, "_read_file", failing_rf)
+        with pytest.raises(PermissionError):
+            await s.load_ops([(actor, 1)])
 
     run(go())
