@@ -95,66 +95,108 @@ async def main() -> None:
     ap.add_argument("--files", type=int, default=64, help="files per writer")
     ap.add_argument("--ops-per-file", type=int, default=48)
     ap.add_argument("--members", type=int, default=512)
+    ap.add_argument("--build-into", help="(internal) build the remote under this dir and exit")
+    ap.add_argument(
+        "--compact-one", nargs=3, metavar=("LOCAL", "REMOTE", "ACCEL"),
+        help="(internal) run one timed compaction (ACCEL: host|tpu) and print JSON",
+    )
     args = ap.parse_args()
 
-    import crdt_enc_tpu
-    from crdt_enc_tpu.parallel import TpuAccelerator
-    from crdt_enc_tpu.utils import trace
+    if args.build_into:
+        total = await build_remote(
+            Path(args.build_into), args.writers, args.files,
+            args.ops_per_file, args.members,
+        )
+        print(total)
+        return
 
-    # persistent compile cache: short-lived compaction jobs must not pay
-    # the tens-of-seconds TPU compile on every run (first run still does)
-    cache = crdt_enc_tpu.enable_compilation_cache()
-    log(f"jax compilation cache: {cache}")
+    if args.compact_one:
+        import hashlib
+        import resource
+
+        import crdt_enc_tpu
+        from crdt_enc_tpu.parallel import TpuAccelerator
+
+        crdt_enc_tpu.enable_compilation_cache()
+        local, remote, kind = args.compact_one
+        accel = TpuAccelerator() if kind == "tpu" else None
+        wall, state_bytes = await timed_compact(Path(local), Path(remote), accel)
+        print(json.dumps({
+            "wall": wall,
+            "rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024,
+            "digest": hashlib.sha256(state_bytes).hexdigest(),
+        }))
+        return
 
     base = Path(tempfile.mkdtemp(prefix="compact-e2e-"))
     log(f"building remote: {args.writers} writers x {args.files} files "
         f"x {args.ops_per_file} ops …")
-    total = await build_remote(
-        base, args.writers, args.files, args.ops_per_file, args.members
+    # the builder holds millions of live op objects — run it in a child so
+    # this process's peak RSS measures the COMPACTIONS, not the synthesis
+    import subprocess
+
+    build = subprocess.run(
+        [sys.executable, __file__, "--build-into", str(base),
+         "--writers", str(args.writers), "--files", str(args.files),
+         "--ops-per-file", str(args.ops_per_file),
+         "--members", str(args.members)],
+        capture_output=True, text=True,
     )
+    if build.returncode != 0:
+        log(build.stderr)
+        raise RuntimeError("remote build failed")
+    total = int(build.stdout.strip().splitlines()[-1])
     n_files = args.writers * args.files
     log(f"remote ready: {n_files} op files, {total} ops")
 
     # byte-identical remote copies: each compaction consumes (GCs) its
-    # remote, so every measurement needs a fresh copy.  The TPU path runs
-    # twice — the first pays per-process jit tracing (compiles come from
-    # the persistent cache) and warms it; the second is the steady state a
-    # long-lived compactor sees.  Both are reported.
+    # remote, so every measurement needs a fresh copy.  Each measurement
+    # runs in its OWN child process so its peak RSS is its own — the TPU
+    # pipelined ingest's bounded-memory claim is only checkable that way.
+    # The TPU path runs twice — the first pays per-process jit tracing
+    # (compiles come from the persistent cache) and warms it; the second
+    # is the steady state a long-lived compactor sees.  Both are reported.
     remote_host = base / "remote"
     remote_tpu_cold = base / "remote-tpu-cold"
     remote_tpu_warm = base / "remote-tpu-warm"
     shutil.copytree(remote_host, remote_tpu_cold)
     shutil.copytree(remote_host, remote_tpu_warm)
 
-    wall_host, state_host = await timed_compact(
-        base / "reader-host", remote_host, None
-    )
-    log(f"host compact: {wall_host:.2f}s -> {total / wall_host:,.0f} ops/s e2e")
+    def compact_child(local: Path, remote: Path, kind: str) -> dict:
+        r = subprocess.run(
+            [sys.executable, __file__, "--compact-one", str(local),
+             str(remote), kind],
+            capture_output=True, text=True,
+        )
+        if r.returncode != 0:
+            log(r.stderr)
+            raise RuntimeError(f"{kind} compaction child failed")
+        return json.loads(r.stdout.strip().splitlines()[-1])
 
-    wall_cold, state_cold = await timed_compact(
-        base / "reader-tpu-cold", remote_tpu_cold, TpuAccelerator()
-    )
-    log(f"tpu  compact (cold process): {wall_cold:.2f}s")
-    trace.reset()
-    wall_tpu, state_tpu = await timed_compact(
-        base / "reader-tpu", remote_tpu_warm, TpuAccelerator()
-    )
-    log(f"tpu  compact (warm): {wall_tpu:.2f}s -> {total / wall_tpu:,.0f} ops/s e2e")
-    log(trace.report())
+    host = compact_child(base / "reader-host", remote_host, "host")
+    log(f"host compact: {host['wall']:.2f}s -> "
+        f"{total / host['wall']:,.0f} ops/s e2e ({host['rss_mb']:.0f}MB)")
+    cold = compact_child(base / "reader-tpu-cold", remote_tpu_cold, "tpu")
+    log(f"tpu  compact (cold process): {cold['wall']:.2f}s")
+    warm = compact_child(base / "reader-tpu", remote_tpu_warm, "tpu")
+    log(f"tpu  compact (warm): {warm['wall']:.2f}s -> "
+        f"{total / warm['wall']:,.0f} ops/s e2e ({warm['rss_mb']:.0f}MB)")
 
-    equal = state_host == state_tpu == state_cold
+    equal = host["digest"] == cold["digest"] == warm["digest"]
     shutil.rmtree(base, ignore_errors=True)
     print(json.dumps({
         "metric": "compaction_e2e_ops_per_sec",
         "n_files": n_files,
         "n_ops": total,
-        "host_wall_s": round(wall_host, 3),
-        "tpu_wall_s": round(wall_tpu, 3),
-        "tpu_cold_wall_s": round(wall_cold, 3),
-        "value": round(total / wall_tpu, 1),
+        "host_wall_s": round(host["wall"], 3),
+        "tpu_wall_s": round(warm["wall"], 3),
+        "tpu_cold_wall_s": round(cold["wall"], 3),
+        "value": round(total / warm["wall"], 1),
         "unit": "ops/s",
-        "vs_baseline": round(wall_host / wall_tpu, 2),
+        "vs_baseline": round(host["wall"] / warm["wall"], 2),
         "byte_equal": bool(equal),
+        "host_rss_mb": round(host["rss_mb"], 1),
+        "tpu_rss_mb": round(warm["rss_mb"], 1),
     }))
 
 

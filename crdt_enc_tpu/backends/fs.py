@@ -204,6 +204,86 @@ class FsStorage(Storage):
     # continues where the previous round stopped.
     NATIVE_SCAN_BATCH = 65_536
     NATIVE_SCAN_BYTES = 256 << 20
+    # Chunk budget for the pipelined ingest (iter_op_chunks): small enough
+    # that a few in-flight chunks bound host memory AND the read/decrypt/
+    # decode/reduce stages get real overlap, large enough that the batched
+    # decrypt/decode amortize.
+    CHUNK_BYTES = 24 << 20
+
+    class _ScanRace(Exception):
+        """A file in the round shrank/vanished/errored between the two
+        native passes; the round starting at ``.version`` needs a per-file
+        re-probe."""
+
+        def __init__(self, version: int):
+            self.version = version
+
+    def _scan_round_native(self, lib, d: bytes, actor: Actor, v: int, max_bytes: int):
+        """One bounded native round.  Returns ``(files, next_v, done)``;
+        raises :class:`_ScanRace` on a mid-round race (nothing consumed)
+        and lets native-load/ctypes errors propagate to the caller."""
+        import ctypes
+
+        import numpy as np
+
+        from .. import native
+
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        sizes = np.zeros(self.NATIVE_SCAN_BATCH, np.int64)
+        n = int(lib.scan_op_sizes(
+            d, v, self.NATIVE_SCAN_BATCH, sizes.ctypes.data_as(i64p)
+        ))
+        if n <= 0:
+            return [], v, True
+        scanned = n
+        sizes = sizes[:n]
+        # byte cap: shrink this round to the prefix that fits (but always
+        # take at least one file so progress is guaranteed)
+        cum = np.cumsum(sizes)
+        if cum[-1] > max_bytes:
+            n = max(1, int(np.searchsorted(cum, max_bytes, "right")))
+            sizes = sizes[:n]
+        offsets = np.zeros(n, np.int64)
+        np.cumsum(sizes[:-1], out=offsets[1:])
+        buf = np.empty(int(sizes.sum()), np.uint8)
+        got = lib.read_op_files(
+            d, v, n,
+            offsets.ctypes.data_as(i64p),
+            sizes.ctypes.data_as(i64p),
+            buf.ctypes.data_as(native.u8p),
+        )
+        if got != n:
+            logger.debug(
+                "native bulk read raced at actor %s v%d; "
+                "re-probing round per-file", actor.hex(), v,
+            )
+            raise self._ScanRace(v)
+        files = [
+            (
+                actor,
+                v + i,
+                buf[int(offsets[i]) : int(offsets[i]) + int(sizes[i])].tobytes(),
+            )
+            for i in range(n)
+        ]
+        done = scanned < self.NATIVE_SCAN_BATCH and n == scanned
+        return files, v + n, done
+
+    @staticmethod
+    def _warn_native_unavailable() -> None:
+        """Fall back to the per-file Python scan, but not silently — a
+        failure here on every load would mask a real native-path bug.  The
+        expected permanent case (no C toolchain: native.load() re-raises
+        its cached build error per call) warns only once."""
+        global _warned_native_scan
+        if not _warned_native_scan:
+            _warned_native_scan = True
+            logger.warning(
+                "native op scan unavailable; using per-file scans "
+                "(logged once)", exc_info=True,
+            )
+        else:
+            logger.debug("native op scan failed", exc_info=True)
 
     def _scan_native(self, actor: Actor, first: int):
         """Dense scan via the native reader.
@@ -216,10 +296,6 @@ class FsStorage(Storage):
         batch/byte caps).  The per-file re-scan then distinguishes a benign
         race (file gone → clean dense end) from a real defect (file present
         but unreadable → loud error), so neither case is masked."""
-        import ctypes
-
-        import numpy as np
-
         from .. import native
 
         out: list[tuple[Actor, int, bytes]] = []
@@ -227,69 +303,107 @@ class FsStorage(Storage):
         try:
             lib = native.load()
             d = self._ops_dir(actor).encode()
-            i64p = ctypes.POINTER(ctypes.c_int64)
             while True:
-                sizes = np.zeros(self.NATIVE_SCAN_BATCH, np.int64)
-                n = int(lib.scan_op_sizes(
-                    d, v, self.NATIVE_SCAN_BATCH, sizes.ctypes.data_as(i64p)
-                ))
-                if n <= 0:
-                    return out, None
-                scanned = n
-                sizes = sizes[:n]
-                # byte cap: shrink this round to the prefix that fits (but
-                # always take at least one file so progress is guaranteed)
-                cum = np.cumsum(sizes)
-                if cum[-1] > self.NATIVE_SCAN_BYTES:
-                    n = max(1, int(np.searchsorted(cum, self.NATIVE_SCAN_BYTES, "right")))
-                    sizes = sizes[:n]
-                offsets = np.zeros(n, np.int64)
-                np.cumsum(sizes[:-1], out=offsets[1:])
-                buf = np.empty(int(sizes.sum()), np.uint8)
-                got = lib.read_op_files(
-                    d, v, n,
-                    offsets.ctypes.data_as(i64p),
-                    sizes.ctypes.data_as(i64p),
-                    buf.ctypes.data_as(native.u8p),
-                )
-                if got != n:
-                    # a file in this round shrank/vanished/errored between
-                    # the passes; keep every completed round and let the
-                    # per-file scan re-probe this one for the exact cause
-                    logger.debug(
-                        "native bulk read raced at actor %s v%d; "
-                        "re-probing round per-file", actor.hex(), v,
+                try:
+                    files, v, done = self._scan_round_native(
+                        lib, d, actor, v, self.NATIVE_SCAN_BYTES
                     )
-                    return out, v
-                # round-local accumulation: out/v must stay consistent even
-                # if an append fails mid-round (the except path resumes at v)
-                round_files = [
-                    (
-                        actor,
-                        v + i,
-                        buf[int(offsets[i]) : int(offsets[i]) + int(sizes[i])].tobytes(),
-                    )
-                    for i in range(n)
-                ]
-                out.extend(round_files)
-                v += n
-                if scanned < self.NATIVE_SCAN_BATCH and n == scanned:
+                except self._ScanRace as race:
+                    return out, race.version
+                out.extend(files)
+                if done:
                     return out, None
         except Exception:
-            # fall back to the per-file Python scan, but not silently — a
-            # failure here on every load would mask a real native-path bug.
-            # The expected permanent case (no C toolchain: native.load()
-            # re-raises its cached build error per call) warns only once.
-            global _warned_native_scan
-            if not _warned_native_scan:
-                _warned_native_scan = True
-                logger.warning(
-                    "native op scan unavailable; using per-file scans "
-                    "(logged once)", exc_info=True,
-                )
-            else:
-                logger.debug("native op scan failed", exc_info=True)
+            self._warn_native_unavailable()
             return (out, v) if out else None
+
+    def _chunk_round(self, actor: Actor, v: int, max_bytes: int):
+        """One bounded round for the chunk iterator: native fast path with
+        a per-file Python continuation on race or native unavailability.
+        Returns ``(files, next_v, done)``."""
+        from .. import native
+
+        files: list[tuple[Actor, int, bytes]] = []
+        size = 0
+        try:
+            lib = native.load()
+            d = self._ops_dir(actor).encode()
+            try:
+                return self._scan_round_native(lib, d, actor, v, max_bytes)
+            except self._ScanRace:
+                pass  # re-probe this round per file below
+        except Exception:
+            self._warn_native_unavailable()
+        dd = self._ops_dir(actor)
+        while size < max_bytes:
+            raw = _read_file(os.path.join(dd, str(v)))
+            if raw is None:
+                return files, v, True
+            files.append((actor, v, raw))
+            size += len(raw)
+            v += 1
+        return files, v, False
+
+    # how many actors scan concurrently ahead of the emitter; in-flight
+    # memory is bounded by ~window × 2 × CHUNK_BYTES (one queued + one
+    # in-progress round per actor)
+    CHUNK_SCAN_WINDOW = 4
+
+    async def iter_op_chunks(
+        self,
+        actor_first_versions: list[tuple[Actor, int]],
+        max_bytes: int | None = None,
+    ):
+        """Bounded-memory op reading for the pipelined ingest: yields
+        ``(actor, version, raw)`` lists of ~max_bytes, per-actor version
+        order preserved across chunks (a chunk may end mid-actor).
+
+        Actors scan concurrently (a window of CHUNK_SCAN_WINDOW, FIFO, so
+        the per-file Python fallback on a high-latency remote does not
+        serialize the whole read stage) while emission stays in actor
+        order."""
+        max_bytes = max_bytes if max_bytes is not None else self.CHUNK_BYTES
+        window = asyncio.Semaphore(self.CHUNK_SCAN_WINDOW)
+
+        async def scan_actor(actor: Actor, first: int, out_q: asyncio.Queue):
+            # the semaphore is held for the actor's whole scan; waiters are
+            # FIFO, so the window always covers the actor being emitted —
+            # no deadlock against the bounded queues
+            async with window:
+                v, done = first, False
+                while not done:
+                    files, v, done = await self._run(
+                        self._chunk_round, actor, v, max_bytes
+                    )
+                    if files:
+                        await out_q.put(files)
+                await out_q.put(None)
+
+        queues: list[asyncio.Queue] = []
+        tasks: list[asyncio.Task] = []
+        for actor, first in actor_first_versions:
+            out_q: asyncio.Queue = asyncio.Queue(maxsize=1)
+            queues.append(out_q)
+            tasks.append(asyncio.create_task(scan_actor(actor, first, out_q)))
+        chunk: list[tuple[Actor, int, bytes]] = []
+        size = 0
+        try:
+            for out_q in queues:
+                while True:
+                    files = await out_q.get()
+                    if files is None:
+                        break
+                    for item in files:
+                        chunk.append(item)
+                        size += len(item[2])
+                        if size >= max_bytes:
+                            yield chunk
+                            chunk, size = [], 0
+            if chunk:
+                yield chunk
+        finally:
+            for t in tasks:
+                t.cancel()
 
     async def load_ops(
         self, actor_first_versions: list[tuple[Actor, int]]

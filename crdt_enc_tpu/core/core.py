@@ -422,6 +422,16 @@ class Core:
         wanted = [
             (a, self._data.next_op_versions.get(a) + 1) for a in sorted(actors)
         ]
+        if not wanted:
+            return
+        if await self._read_remote_ops_pipelined(wanted, actors):
+            return
+        # legacy whole-batch flow (no fold session, or the pipeline hit a
+        # structural surprise): cursors already reflect everything the
+        # pipeline folded, so recompute and load only the remainder
+        wanted = [
+            (a, self._data.next_op_versions.get(a) + 1) for a in sorted(actors)
+        ]
         with trace.span("ops.load"):
             files = await self.storage.load_ops(wanted)
         trace.add("op_files_loaded", len(files))
@@ -462,6 +472,232 @@ class Core:
             with trace.span("ops.fold"):
                 self.accel.fold_ops(self._data.state, batch)
             trace.add("ops_folded", len(batch))
+
+    # ------------------------------------------------- pipelined bulk ingest
+    def _validate_chunk(self, files: list, clears: list, overlay=None):
+        """Sync section: ordered version bookkeeping for one chunk WITHOUT
+        advancing the global cursors (the caller advances only after the
+        chunk's fold is accepted — a declined or failed chunk stays
+        re-readable).  ``overlay`` carries validated-but-not-yet-advanced
+        versions across chunks when several are in flight.  Returns
+        ``(payloads, metas)``; skew tolerance and gap errors exactly as
+        lib.rs:519-531."""
+        payloads, metas = [], []
+        local: dict[Actor, int] = overlay if overlay is not None else {}
+        for (actor, version, _), clear in zip(files, clears):
+            expected = (
+                max(self._data.next_op_versions.get(actor), local.get(actor, 0))
+                + 1
+            )
+            if version < expected:
+                continue  # concurrent-read tolerance (lib.rs:521-525)
+            if version > expected:
+                raise OpOrderError(
+                    f"op file v{version} for {uuid.UUID(bytes=actor)} arrived "
+                    f"beyond expected v{expected}"
+                )
+            inner = VersionBytes.deserialize(clear).ensure_versions(
+                self.supported_data_versions
+            )
+            payloads.append(inner.content)
+            metas.append((actor, version))
+            local[actor] = version
+        return payloads, metas
+
+    def _advance_cursors(self, metas: list) -> None:
+        for actor, version in metas:
+            self._data.next_op_versions.apply(Dot(actor, version))
+
+    async def _fold_chunk_python(self, files: list, clears: list) -> None:
+        """Per-op fallback fold of one decrypted chunk (non-columnar CRDT
+        or a session decline) — bounded by the chunk size."""
+        payloads, metas = self._validate_chunk(files, clears)
+        if not payloads:
+            return
+        batch = []
+        for p in payloads:
+            batch.extend(self.adapter.op_from_obj(o) for o in codec.unpack(p))
+        if batch:
+            with trace.span("ops.fold"):
+                self.accel.fold_ops(self._data.state, batch)
+            trace.add("ops_folded", len(batch))
+        self._advance_cursors(metas)
+
+    async def _read_remote_ops_pipelined(self, wanted, actors) -> bool:
+        """Bounded-memory overlapped ingest: the reader+decryptor task
+        streams chunks (storage.iter_op_chunks → outer unwrap → batched
+        native decrypt) through a small queue while this task validates,
+        decodes, and folds them through a fold session — read of chunk
+        i+1 overlaps decrypt of chunk i and fold of chunk i-1, and host
+        memory is bounded by chunk size × queue depth (SURVEY.md §7 hard
+        part 3; restructures ref lib.rs:471-547).
+
+        Returns True when the stream was fully consumed; False hands the
+        remainder to the legacy path (an outer-envelope surprise there
+        produces the precise per-file error)."""
+        open_session = getattr(self.accel, "open_fold_session", None)
+        if open_session is None:
+            return False
+        session = open_session(self._data.state, actors_hint=actors)
+        if session is None:
+            return False
+
+        q: asyncio.Queue = asyncio.Queue(maxsize=2)
+
+        async def produce():
+            try:
+                async for files in self.storage.iter_op_chunks(wanted):
+                    try:
+                        with trace.span("ops.chunk_unwrap"):
+                            key_ids, middles = [], []
+                            for _, _, raw in files:
+                                outer = VersionBytes.deserialize(
+                                    raw
+                                ).ensure_versions(SUPPORTED_CONTAINER_VERSIONS)
+                                kid, middle = codec.unpack(outer.content)
+                                key_ids.append(bytes(kid))
+                                middles.append(bytes(middle))
+                    except Exception:
+                        await q.put(("abort",))
+                        return
+                    groups: dict[bytes, list[int]] = {}
+                    for i, kid in enumerate(key_ids):
+                        groups.setdefault(kid, []).append(i)
+                    clears: list = [None] * len(files)
+                    with trace.span("ops.chunk_decrypt"):
+                        for kid, idxs in groups.items():
+                            key = self._data.keys.get_key(kid)
+                            if key is None:
+                                raise MissingKeyError(
+                                    "ops sealed with unknown key "
+                                    f"{uuid.UUID(bytes=kid)}; key metadata "
+                                    "may not have synced yet"
+                                )
+                            outs = await self.cryptor.decrypt_batch(
+                                key.material, [middles[i] for i in idxs]
+                            )
+                            for i, clear in zip(idxs, outs):
+                                clears[i] = clear
+                    trace.add("bytes_decrypted", sum(len(m) for m in middles))
+                    await q.put(("chunk", files, clears))
+                await q.put(("end",))
+            except Exception as e:
+                await q.put(("error", e))
+
+        from ..parallel.session import SessionDeclined
+
+        producer = asyncio.create_task(produce())
+        session_done = False
+        python_mode = False
+        pending: list[tuple[list, list]] = []  # buffered below BULK_MIN_FILES
+        pending_files = 0
+        session_started = False
+        fed_files = 0
+        overlay: dict[Actor, int] = {}  # validated-but-unadvanced versions
+        # decode runs in parallel threads (pure, GIL-released ctypes);
+        # reduces drain strictly FIFO so per-actor cursor advancement stays
+        # in version order even under a mid-stream failure
+        inflight: list[tuple] = []  # (decode_task, metas, files, clears)
+        MAX_DECODES = 2
+
+        async def finish_session():
+            # state mutates ONLY here; must precede any python-mode fold
+            # (the session's plane capture would clobber a direct fold).
+            # Deliberately SYNCHRONOUS: finish reads the state, combines,
+            # and writes it back — in a worker thread an update() landing
+            # between its read and writeback would be silently clobbered.
+            # One event-loop stall (≈combine+writeback) buys atomicity.
+            nonlocal session_done
+            if not session_done:
+                session_done = True
+                with trace.span("ops.session_finish"):
+                    session.finish()
+
+        async def drain_one() -> None:
+            """Complete the oldest in-flight chunk: await its decode,
+            reduce it (serialized), advance its cursors.  A decline flips
+            to per-op python folds for it and everything after."""
+            nonlocal python_mode, fed_files
+            task, metas, files, clears = inflight.pop(0)
+            try:
+                decoded = await task
+                if python_mode:
+                    raise SessionDeclined("session already degraded")
+                with trace.span("ops.chunk_fold"):
+                    await asyncio.to_thread(session.reduce_chunk, decoded)
+            except SessionDeclined:
+                if not python_mode:
+                    await finish_session()
+                    python_mode = True
+                await self._fold_chunk_python(files, clears)
+                return
+            self._advance_cursors(metas)
+            fed_files += len(files)
+
+        async def dispatch(files, clears) -> None:
+            nonlocal python_mode
+            if python_mode:
+                await self._fold_chunk_python(files, clears)
+                return
+            payloads, metas = self._validate_chunk(files, clears, overlay)
+            if not payloads:
+                return
+            task = asyncio.create_task(
+                asyncio.to_thread(session.decode_chunk, payloads)
+            )
+            inflight.append((task, metas, files, clears))
+            if len(inflight) >= MAX_DECODES:
+                await drain_one()
+
+        try:
+            while True:
+                item = await q.get()
+                tag = item[0]
+                if tag == "end":
+                    break
+                if tag == "error":
+                    raise item[1]
+                if tag == "abort":
+                    # drain the fed prefix, then let the legacy path take
+                    # the remainder (and produce its precise error)
+                    while inflight:
+                        await drain_one()
+                    await finish_session()
+                    for files, clears in pending:
+                        await self._fold_chunk_python(files, clears)
+                    pending = []
+                    return False
+                _, files, clears = item
+                if not session_started and not python_mode:
+                    pending.append((files, clears))
+                    pending_files += len(files)
+                    if pending_files < BULK_MIN_FILES:
+                        continue
+                    session_started = True
+                    backlog, pending = pending, []
+                    for f, c in backlog:
+                        await dispatch(f, c)
+                    continue
+                await dispatch(files, clears)
+            # stream fully consumed; a never-promoted tiny ingest folds
+            # per-op, the same shape as the legacy small path (decrypt
+            # already happened, batched)
+            while inflight:
+                await drain_one()
+            await finish_session()
+            for files, clears in pending:
+                await self._fold_chunk_python(files, clears)
+            pending = []
+            return True
+        finally:
+            producer.cancel()
+            for task, *_ in inflight:
+                task.cancel()
+            # fold whatever was fed — chunks whose cursors advanced must
+            # land in the state even on an exceptional exit
+            await finish_session()
+            if fed_files:
+                trace.add("op_files_bulk_folded", fed_files)
 
     async def _read_remote_ops_bulk(self, files: list, actors) -> bool:
         """Bulk ingestion: unwrap all outer envelopes, one batched decrypt

@@ -73,6 +73,24 @@ class Storage(ABC):
         version ≥ first, in version order per actor (scan until the first
         missing version, tolerating none at all)."""
 
+    async def iter_op_chunks(
+        self,
+        actor_first_versions: list[tuple[Actor, int]],
+        max_bytes: int = 64 << 20,
+    ):
+        """Async-iterate op files in bounded chunks — the feed for the
+        core's pipelined bulk ingest (read of chunk i+1 overlaps decrypt +
+        fold of chunk i, host memory bounded by ~max_bytes per stage).
+
+        Yields lists of ``(actor, version, raw)``; concatenated, the lists
+        must equal ``load_ops`` of the same request (per-actor version
+        order holds ACROSS chunks; a chunk may end mid-actor).  This base
+        implementation degrades to one ``load_ops`` chunk — backends with
+        real IO (fs) override it with incremental scans."""
+        chunk = await self.load_ops(actor_first_versions)
+        if chunk:
+            yield chunk
+
     @abstractmethod
     async def store_ops(self, actor: Actor, version: int, data: bytes) -> None: ...
 

@@ -192,6 +192,34 @@ def orset_fold_coo(
     return clock, skey, scounter, is_seg_max
 
 
+@jax.jit
+def orset_apply_batch_planes(
+    clock0: jax.Array,  # (R,) int32 — CURRENT state clock
+    add0: jax.Array,  # (E, R) int32 — current state planes
+    rm0: jax.Array,
+    add_b: jax.Array,  # (E, R) int32 — batch-reduced planes (leaf fold)
+    rm_b: jax.Array,
+):
+    """Apply pre-reduced op-batch planes to the state planes: the tail of
+    :func:`orset_fold` after the scatter phase, with the stale-add mask
+    lifted to cell level — ``add_b`` cells not beyond the CURRENT clock
+    are replays (per-actor dot counters are monotone, so a stale cell max
+    means every dot in the cell was stale) and drop, exactly as the
+    kernel's row-level ``seen`` mask would have dropped them.  Evaluating
+    the mask against the clock *now* (not at session start) keeps the
+    combine correct when concurrent applies or state merges advanced the
+    state while chunks were being reduced.  NOT the CvRDT state merge
+    (``orset_merge``) — batch rows are ops, so no clock-filter survivor
+    rule applies to them."""
+    add_b = jnp.where(add_b > clock0[None, :], add_b, 0)
+    clock = jnp.maximum(clock0, jnp.max(add_b, axis=0, initial=0))
+    add = jnp.maximum(add0, add_b)
+    rm = jnp.maximum(rm0, rm_b)
+    add = jnp.where(add > rm, add, 0)
+    rm = jnp.where(rm > clock[None, :], rm, 0)
+    return clock, add, rm
+
+
 def merge_rule(clock_a, add_a, rm_a, clock_b, add_b, rm_b, clock_merged):
     """The clock-filter merge on raw arrays (clocks already row-broadcast
     ready, ``clock_merged = max(clock_a, clock_b)`` supplied by the

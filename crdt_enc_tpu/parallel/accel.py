@@ -19,6 +19,7 @@ from ..core.adapters import HostAccelerator
 from ..models import GCounter, LWWMap, ORSet, PNCounter
 from ..models.counters import NEG, POS
 from ..models.vclock import Dot, VClock
+from ..utils import trace
 from .. import ops as K
 
 MIN_DEVICE_BATCH = 256  # below this the host loop wins
@@ -100,7 +101,8 @@ class TpuAccelerator(HostAccelerator):
         Sparse batches over huge vocabularies take the sorted-COO kernel
         instead — same semantics, no dense plane materialization."""
         n_rows = len(kind)
-        K.orset_scan_vocab(state, members, replicas)
+        with trace.span("fold.vocab"):
+            K.orset_scan_vocab(state, members, replicas)
         E, R = len(members), len(replicas)
         if E == 0 or R == 0:
             return state
@@ -121,37 +123,41 @@ class TpuAccelerator(HostAccelerator):
             return K.orset_fold_sparse_host(
                 state, kind, member, actor, counter, members, replicas
             )
-        clock0, add0, rm0 = K.orset_state_to_planes(
-            state, members, replicas, scanned=True
-        )
-        if n_rows > self.STREAM_CHUNK_ROWS:
-            # blockwise fold with donated plane buffers: bounded device
-            # memory for arbitrarily large ingests (ops/stream.py)
-            clock, add, rm = K.orset_fold_stream(
-                clock0, add0, rm0,
-                K.iter_orset_chunks(
-                    kind, member, actor, counter,
-                    self.STREAM_CHUNK_ROWS, R,
-                ),
-                num_members=E, num_replicas=R,
+        with trace.span("fold.planes"):
+            clock0, add0, rm0 = K.orset_state_to_planes(
+                state, members, replicas, scanned=True
             )
-        else:
-            cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
-            K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
-            clock, add, rm = K.orset_fold(
-                clock0,
-                add0,
-                rm0,
-                cols.kind,
-                cols.member,
-                cols.actor,
-                cols.counter,
-                num_members=E,
-                num_replicas=R,
+        with trace.span("fold.device"):
+            if n_rows > self.STREAM_CHUNK_ROWS:
+                # blockwise fold with donated plane buffers: bounded device
+                # memory for arbitrarily large ingests (ops/stream.py)
+                clock, add, rm = K.orset_fold_stream(
+                    clock0, add0, rm0,
+                    K.iter_orset_chunks(
+                        kind, member, actor, counter,
+                        self.STREAM_CHUNK_ROWS, R,
+                    ),
+                    num_members=E, num_replicas=R,
+                )
+            else:
+                cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
+                K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
+                clock, add, rm = K.orset_fold(
+                    clock0,
+                    add0,
+                    rm0,
+                    cols.kind,
+                    cols.member,
+                    cols.actor,
+                    cols.counter,
+                    num_members=E,
+                    num_replicas=R,
+                )
+            clock, add, rm = (
+                np.asarray(clock), np.asarray(add), np.asarray(rm),
             )
-        folded = K.orset_planes_to_state(
-            np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
-        )
+        with trace.span("fold.writeback"):
+            folded = K.orset_planes_to_state(clock, add, rm, members, replicas)
         state.clock = folded.clock
         state.entries = folded.entries
         state.deferred = folded.deferred
@@ -199,6 +205,15 @@ class TpuAccelerator(HostAccelerator):
         state.deferred = folded.deferred
         return state
 
+    # ------------------------------------------------------- fold sessions
+    def open_fold_session(self, state, actors_hint=()):
+        """A chunked fold session for the core's pipelined bulk ingest
+        (parallel/session.py), or None for CRDT types without a columnar
+        chunk path — the core then uses the legacy whole-batch flow."""
+        from .session import open_fold_session
+
+        return open_fold_session(self, state, actors_hint)
+
     # -------------------------------------------------------- fold_payloads
     def fold_payloads(self, state, payloads: list, actors_hint=()) -> bool:
         """Bulk front end: decrypted op-file payloads → native columnar
@@ -218,7 +233,8 @@ class TpuAccelerator(HostAccelerator):
         for dfr in state.deferred.values():
             actor_set.update(dfr)
         actors_sorted = sorted(actor_set)
-        decoded = decode_orset_payload_batch(payloads, actors_sorted)
+        with trace.span("fold.decode"):
+            decoded = decode_orset_payload_batch(payloads, actors_sorted)
         if decoded is None:
             return False
         kind, member_idx, actor_idx, counter, member_objs = decoded

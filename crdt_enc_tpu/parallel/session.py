@@ -1,0 +1,461 @@
+"""Chunked fold sessions: bounded-memory, transfer-aware bulk ingestion.
+
+A session consumes decrypted op-file payloads chunk by chunk (fed by the
+core's pipelined reader, core.py ``_read_remote_ops_pipelined``) and folds
+them into one CRDT state with memory bounded by the chunk size — the
+restructuring of the reference's consumer path (crdt-enc/src/lib.rs:471-547)
+that SURVEY.md §7 hard part 3 calls for.
+
+Three execution modes, chosen adaptively because the dominant cost changes
+with regime (measured on v5e via the tunnel — see BASELINE.md):
+
+* **BUFFER** — small ingests accumulate columns and fold once at finish
+  through the accelerator's existing regime-picking tail (sparse host /
+  dense device / mesh).  Promotion out of BUFFER happens the moment the
+  accumulated column bytes exceed ``BUFFER_BYTES``, so memory stays small.
+* **HOST_REDUCE** — when the dense state planes are small relative to the
+  row stream (``3·E·R·4 ≪ N·13``), shipping every row to the device is
+  pure transfer cost (the fold itself is a segment-max the host can run at
+  memory bandwidth).  Each chunk reduces into persistent host planes with
+  ``np.maximum.at``; ONE tiny device pass applies the batch planes to the
+  state planes at finish.  This is a hierarchical fold: host does the leaf
+  level on data it necessarily already holds (it just decrypted it),
+  device does the combine — bytes over the interconnect drop from
+  ``N·13`` to ``6·E·R·4``.
+* **DEVICE_STREAM** — when the planes themselves are large (E·R beyond
+  ``HOST_PLANE_CELLS``), host reduction thrashes caches and the planes,
+  not the rows, dominate transfer; the planes live on device (donated
+  between chunks, ops/stream.py) and fixed-shape row chunks stream
+  through the compiled fold — device memory stays at one chunk + planes.
+
+Exactness: every mode reproduces the one-big-``orset_fold`` semantics.
+HOST_REDUCE masks stale adds against the state clock captured at session
+start (exactly the kernel's ``seen`` mask); DEVICE_STREAM's carried clock
+only ever rejects true replays under the core's per-actor version ordering
+(ops/stream.py module docs).  Byte equality vs the host loop is pinned in
+tests/test_fold_session.py across all modes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as K
+from ..models import GCounter, ORSet, PNCounter
+from ..models.counters import POS
+from ..ops.columnar import KIND_ADD, KIND_RM
+from ..utils import trace
+
+BUFFER_BYTES = 4 << 20  # promote out of BUFFER beyond this many column bytes
+# host-reduce planes up to E·R = 128M cells (~1.5GB for 3 int32 planes):
+# np.maximum.at runs at memory bandwidth and the combine is elementwise, so
+# host reduction wins until the planes threaten host RAM — only beyond that
+# is the donated-buffer device stream (bounded device memory) the answer
+HOST_PLANE_CELLS = 1 << 27
+DEVICE_CHUNK_ROWS = 1 << 20  # device-stream row bucket (one compile)
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class SessionDeclined(Exception):
+    """The native decoder cannot represent this chunk (non-canonical
+    encoding, vocab collision); the caller must fold it another way."""
+
+
+def apply_batch_planes_host(clock0, add0, rm0, add_b, rm_b):
+    """numpy mirror of :func:`crdt_enc_tpu.ops.orset.orset_apply_batch_planes`
+    for small planes, where a device round-trip is pure latency.  The two
+    must never diverge — tests/test_fold_session.py pins them equal on
+    randomized inputs."""
+    add_b = np.where(add_b > clock0[None, :], add_b, 0)
+    clock = np.maximum(clock0, add_b.max(axis=0, initial=0))
+    add = np.maximum(add0, add_b)
+    rm = np.maximum(rm0, rm_b)
+    add = np.where(add > rm, add, 0)
+    rm = np.where(rm > clock[None, :], rm, 0)
+    return clock, add, rm
+
+
+class OrsetFoldSession:
+    """Fold ORSet op-file payloads chunk by chunk into ``state``.
+
+    Protocol: ``feed(payloads)`` per chunk (raises :class:`SessionDeclined`
+    with the chunk unconsumed if the native decoder declines), then
+    ``finish()`` exactly once — only finish mutates ``state``.
+    """
+
+    def __init__(self, accel, state: ORSet, actors_hint=()):
+        self.accel = accel
+        self.state = state
+        actor_set = set(actors_hint)
+        actor_set.update(state.clock.counters)
+        for entry in state.entries.values():
+            actor_set.update(entry)
+        for dfr in state.deferred.values():
+            actor_set.update(dfr)
+        self.actors_sorted = sorted(actor_set)
+        self.replicas = K.Vocab(self.actors_sorted)
+        self.members = K.Vocab()
+        K.orset_scan_vocab(state, self.members, self.replicas)
+        self._state_members = len(self.members)
+        self.R = len(self.replicas)
+        # the kernel's stale-add mask is evaluated against the clock as of
+        # session start for EVERY chunk — one-big-batch semantics
+        self._clock0 = np.zeros(max(self.R, 1), np.int32)
+        for i, a in enumerate(self.actors_sorted):
+            self._clock0[i] = state.clock.get(a)
+        self.mode = "buffer"
+        self._buffered: list[tuple] = []
+        self._buffered_bytes = 0
+        self.rows_fed = 0
+        # HOST_REDUCE accumulators (allocated at promotion)
+        self._h_add = self._h_rm = None
+        # DEVICE_STREAM carry (allocated at promotion)
+        self._d_planes = None
+        self._d_E = 0
+        self._finished = False
+
+    # ------------------------------------------------------------------ feed
+    def decode_chunk(self, payloads: list):
+        """Stage 1, thread-safe (no session mutation): native columnar
+        decode of one chunk's payloads.  The ctypes call releases the GIL,
+        so the core decodes chunk i+1 while chunk i reduces."""
+        from ..ops.native_decode import decode_orset_payload_batch
+
+        with trace.span("session.decode"):
+            decoded = decode_orset_payload_batch(payloads, self.actors_sorted)
+        if decoded is None:
+            raise SessionDeclined("native decoder declined the chunk")
+        return decoded
+
+    def reduce_chunk(self, decoded) -> None:
+        """Stage 2, serialized by the caller (mutates vocab + planes)."""
+        assert not self._finished, "session already finished"
+        kind, member_idx, actor_idx, counter, member_objs = decoded
+        if len(kind) == 0:
+            return
+        with trace.span("session.remap"):
+            member_global = self._remap_members(member_idx, member_objs)
+        self.rows_fed += len(kind)
+        cols = (kind, member_global, actor_idx, counter)
+        if self.mode == "buffer":
+            self._buffered.append(cols)
+            self._buffered_bytes += len(kind) * 13
+            if self._buffered_bytes > BUFFER_BYTES:
+                self._promote()
+        elif self.mode == "host_reduce":
+            self._host_reduce(*cols)
+        else:
+            self._device_feed(*cols)
+
+    def feed(self, payloads: list) -> None:
+        """decode + reduce in one call (single-threaded convenience)."""
+        self.reduce_chunk(self.decode_chunk(payloads))
+
+    def _remap_members(self, member_idx, member_objs):
+        """Chunk-local member interning → the session-global vocabulary.
+        Python work is one intern per *distinct* member per chunk; rows
+        remap vectorized."""
+        table = np.empty(len(member_objs), np.int32)
+        for i, obj in enumerate(member_objs):
+            table[i] = self.members.intern(obj)
+        if len(set(table.tolist())) != len(member_objs):
+            # distinct canonical bytes colliding as Python values
+            # (1 == True): the dense planes cannot represent this —
+            # decline so the caller uses the per-op path (which matches
+            # the host dict semantics exactly)
+            raise SessionDeclined("member vocab collision")
+        return table[member_idx]
+
+    # ------------------------------------------------------------- promotion
+    def _promote(self) -> None:
+        """Leave BUFFER mode: pick the cheap representation for this regime
+        and replay the buffered chunks through it."""
+        if getattr(self.accel, "_mesh_active", lambda: False)():
+            # mesh ingests finish through the sharded fold, which wants the
+            # whole row batch — stay buffered (multi-chip compaction trades
+            # host memory for SPMD execution; revisit if it matters)
+            return
+        E_est = _bucket(max(len(self.members), 1))
+        if E_est * self.R <= HOST_PLANE_CELLS:
+            self.mode = "host_reduce"
+            self._h_add = np.zeros((E_est, self.R), np.int32)
+            self._h_rm = np.zeros((E_est, self.R), np.int32)
+            for cols in self._buffered:
+                self._host_reduce(*cols)
+        else:
+            self.mode = "device_stream"
+            # overshoot the member capacity: every growth step recompiles
+            # the donated fold for the new static shape, so fewer, larger
+            # steps (the compile cache then amortizes across runs)
+            self._d_E = _bucket(max(len(self.members), 1) * 4)
+            clock0, add0, rm0 = self._state_planes(self._d_E)
+            import jax
+
+            self._d_planes = (
+                jax.device_put(clock0),
+                jax.device_put(add0),
+                jax.device_put(rm0),
+            )
+            for cols in self._buffered:
+                self._device_feed(*cols)
+        self._buffered = []
+        self._buffered_bytes = 0
+
+    def _state_planes(self, E_pad: int):
+        clock0, add0, rm0 = K.orset_state_to_planes(
+            self.state, self.members, self.replicas, scanned=True
+        )
+        E = add0.shape[0]
+        if E_pad > E:
+            # column count follows the CURRENT replica vocab — it may have
+            # grown past self.R if a concurrent apply introduced an actor
+            z = np.zeros((E_pad - E, len(self.replicas)), np.int32)
+            add0 = np.concatenate([add0, z])
+            rm0 = np.concatenate([rm0, z])
+        return clock0, add0, rm0
+
+    # ------------------------------------------------- host-reduce internals
+    def _grow_host_planes(self) -> None:
+        E_new = _bucket(len(self.members))
+        grow = E_new - self._h_add.shape[0]
+        if grow > 0:
+            z = np.zeros((grow, self.R), np.int32)
+            self._h_add = np.concatenate([self._h_add, z])
+            self._h_rm = np.concatenate([self._h_rm, z])
+
+    def _host_reduce(self, kind, member, actor, counter) -> None:
+        """The leaf-level fold on host: exactly orset_fold's masked
+        scatter-max (ops/orset.py:84-131), via np.maximum.at."""
+        if len(self.members) > self._h_add.shape[0]:
+            self._grow_host_planes()
+        with trace.span("session.host_reduce"):
+            valid = actor < self.R
+            seen = counter <= self._clock0[np.minimum(actor, self.R - 1)]
+            live_add = (kind == KIND_ADD) & valid & ~seen
+            is_rm = (kind == KIND_RM) & valid
+            np.maximum.at(
+                self._h_add,
+                (member[live_add], actor[live_add]),
+                counter[live_add],
+            )
+            np.maximum.at(
+                self._h_rm, (member[is_rm], actor[is_rm]), counter[is_rm]
+            )
+
+    # ------------------------------------------------ device-stream internals
+    def _grow_device_planes(self) -> None:
+        E_new = _bucket(len(self.members) * 4)  # overshoot (see _promote)
+        if E_new > self._d_E:
+            import jax.numpy as jnp
+
+            clock, add, rm = self._d_planes
+            pad = E_new - self._d_E
+            add = jnp.pad(add, ((0, pad), (0, 0)))
+            rm = jnp.pad(rm, ((0, pad), (0, 0)))
+            self._d_planes = (clock, add, rm)
+            self._d_E = E_new
+
+    def _device_feed(self, kind, member, actor, counter) -> None:
+        from ..ops.stream import _fold_donated, iter_orset_chunks
+
+        if len(self.members) > self._d_E:
+            self._grow_device_planes()
+        with trace.span("session.device_fold"):
+            rows = min(DEVICE_CHUNK_ROWS, _bucket(len(kind)))
+            clock, add, rm = self._d_planes
+            for chunk in iter_orset_chunks(kind, member, actor, counter, rows, self.R):
+                clock, add, rm = _fold_donated(
+                    clock, add, rm, *chunk,
+                    num_members=self._d_E, num_replicas=self.R,
+                    impl="fused", small_counters=False,
+                )
+            # no block: jax dispatch is async — the next chunk's decrypt
+            # and decode overlap the device work
+            self._d_planes = (clock, add, rm)
+
+    # ---------------------------------------------------------------- finish
+    def finish(self) -> ORSet:
+        """Fold everything fed into ``state`` (the only state mutation).
+
+        Concurrency-correct by construction: the state is re-read HERE, in
+        one sync section, so applies or state merges that landed while
+        chunks were in flight are honored — HOST_REDUCE re-evaluates the
+        stale mask against the current clock inside
+        ``orset_apply_batch_planes``; DEVICE_STREAM combines through the
+        CvRDT ``orset_merge`` (the device planes are a valid state
+        descended from the promotion snapshot, so merge semantics apply)."""
+        assert not self._finished, "session already finished"
+        self._finished = True
+        state = self.state
+        if self.mode == "buffer":
+            if not self._buffered:
+                return state
+            kind = np.concatenate([c[0] for c in self._buffered])
+            member = np.concatenate([c[1] for c in self._buffered])
+            actor = np.concatenate([c[2] for c in self._buffered])
+            counter = np.concatenate([c[3] for c in self._buffered])
+            self._buffered = []
+            if len(self.members) == 0 or self.R == 0:
+                return state
+            return self.accel._fold_orset_columns(
+                state, kind, member, actor, counter, self.members, self.replicas
+            )
+        # concurrent applies may have introduced members (never actors —
+        # feeds only ever index the fixed actors_sorted columns, and new
+        # actors' dots live in the state planes, re-read below)
+        K.orset_scan_vocab(state, self.members, self.replicas)
+        E = len(self.members)
+        R_final = len(self.replicas)
+        if self.mode == "host_reduce":
+            with trace.span("session.combine"):
+                E_pad = max(self._h_add.shape[0], _bucket(max(E, 1)))
+                clock0, add0, rm0 = self._state_planes(E_pad)
+                add_b = self._pad_batch(self._h_add, E_pad, R_final)
+                rm_b = self._pad_batch(self._h_rm, E_pad, R_final)
+                # the combine is one elementwise pass — the host runs it at
+                # memory bandwidth on planes it already holds, so shipping
+                # them to an accelerator is pure interconnect cost at ANY
+                # size (the jit twin orset_apply_batch_planes exists for
+                # callers whose planes are already device-resident, and
+                # tests pin the two equal)
+                clock, add, rm = apply_batch_planes_host(
+                    clock0, add0, rm0, add_b, rm_b
+                )
+        else:
+            with trace.span("session.device_finish"):
+                d_clock, d_add, d_rm = (np.asarray(x) for x in self._d_planes)
+                E_pad = max(self._d_E, _bucket(max(E, 1)))
+                clock0, add0, rm0 = self._state_planes(E_pad)
+                d_add = self._pad_batch(d_add, E_pad, R_final)
+                d_rm = self._pad_batch(d_rm, E_pad, R_final)
+                d_clock = self._pad_clock(d_clock, R_final)
+                clock, add, rm = (
+                    np.asarray(x)
+                    for x in K.orset_merge(
+                        clock0, add0, rm0, d_clock, d_add, d_rm
+                    )
+                )
+        with trace.span("session.writeback"):
+            folded = K.orset_planes_to_state(
+                clock, add[:E], rm[:E], self.members, self.replicas
+            )
+        state.clock = folded.clock
+        state.entries = folded.entries
+        state.deferred = folded.deferred
+        return state
+
+    @staticmethod
+    def _pad_batch(plane, E_pad: int, R_final: int):
+        e, r = plane.shape
+        if e == E_pad and r == R_final:
+            return plane
+        out = np.zeros((E_pad, R_final), np.int32)
+        out[:e, :r] = plane
+        return out
+
+    @staticmethod
+    def _pad_clock(clock, R_final: int):
+        if len(clock) == R_final:
+            return clock
+        out = np.zeros(R_final, np.int32)
+        out[: len(clock)] = clock
+        return out
+
+
+class CounterFoldSession:
+    """Chunked G/PN-Counter ingestion: per-actor maxima reduce on host per
+    chunk (the planes are O(R) — transfer and scatter are both trivial),
+    one device combine at finish."""
+
+    def __init__(self, accel, state, actors_hint=()):
+        self.accel = accel
+        self.state = state
+        self.is_pn = isinstance(state, PNCounter)
+        clocks = (
+            (state.p.clock, state.n.clock) if self.is_pn else (state.clock,)
+        )
+        actor_set = set(actors_hint)
+        for c in clocks:
+            actor_set.update(c.counters)
+        self.actors_sorted = sorted(actor_set)
+        self.replicas = K.Vocab(self.actors_sorted)
+        self.R = len(self.replicas)
+        self._p = np.zeros(max(self.R, 1), np.int32)
+        self._n = np.zeros(max(self.R, 1), np.int32)
+        self.rows_fed = 0
+        self._finished = False
+
+    def decode_chunk(self, payloads: list):
+        from ..ops.native_decode import decode_counter_payload_batch
+
+        decoded = decode_counter_payload_batch(payloads, self.actors_sorted)
+        if decoded is None:
+            raise SessionDeclined("native decoder declined the chunk")
+        sign = decoded[0]
+        if len(sign) and isinstance(self.state, GCounter) and np.any(sign != POS):
+            raise SessionDeclined("PN-shaped rows in a G-Counter state")
+        return decoded
+
+    def reduce_chunk(self, decoded) -> None:
+        assert not self._finished, "session already finished"
+        sign, actor_idx, counter = decoded
+        if len(sign) == 0:
+            return
+        self.rows_fed += len(sign)
+        pos = sign == POS
+        np.maximum.at(self._p, actor_idx[pos], counter[pos])
+        np.maximum.at(self._n, actor_idx[~pos], counter[~pos])
+
+    def feed(self, payloads: list) -> None:
+        self.reduce_chunk(self.decode_chunk(payloads))
+
+    def finish(self):
+        assert not self._finished, "session already finished"
+        self._finished = True
+        state = self.state
+        if self.R == 0 or self.rows_fed == 0:
+            return state
+        # concurrent applies may have introduced actors since init: rescan
+        # the state clocks (fed rows only ever index the original columns)
+        clocks = (
+            (state.p.clock, state.n.clock) if self.is_pn else (state.clock,)
+        )
+        for c in clocks:
+            for a in c.counters:
+                self.replicas.intern(a)
+        R_final = len(self.replicas)
+        p = self._pad(self._p, R_final)
+        n = self._pad(self._n, R_final)
+        if self.is_pn:
+            p0 = K.vclock_to_dense(state.p.clock, self.replicas)
+            n0 = K.vclock_to_dense(state.n.clock, self.replicas)
+            state.p.clock = K.dense_to_vclock(np.maximum(p0, p), self.replicas)
+            state.n.clock = K.dense_to_vclock(np.maximum(n0, n), self.replicas)
+        else:
+            c0 = K.vclock_to_dense(state.clock, self.replicas)
+            state.clock = K.dense_to_vclock(np.maximum(c0, p), self.replicas)
+        return state
+
+    @staticmethod
+    def _pad(arr, R_final: int):
+        if len(arr) == R_final:
+            return arr
+        out = np.zeros(R_final, np.int32)
+        out[: len(arr)] = arr
+        return out
+
+
+def open_fold_session(accel, state, actors_hint=()):
+    """A fold session for ``state``, or None when no chunked columnar path
+    exists for its type (the caller folds chunks through the per-op path)."""
+    if isinstance(state, ORSet):
+        return OrsetFoldSession(accel, state, actors_hint)
+    if isinstance(state, (GCounter, PNCounter)):
+        return CounterFoldSession(accel, state, actors_hint)
+    return None
