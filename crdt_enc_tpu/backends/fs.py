@@ -369,15 +369,20 @@ class FsStorage(Storage):
             # the semaphore is held for the actor's whole scan; waiters are
             # FIFO, so the window always covers the actor being emitted —
             # no deadlock against the bounded queues
-            async with window:
-                v, done = first, False
-                while not done:
-                    files, v, done = await self._run(
-                        self._chunk_round, actor, v, max_bytes
-                    )
-                    if files:
-                        await out_q.put(files)
-                await out_q.put(None)
+            try:
+                async with window:
+                    v, done = first, False
+                    while not done:
+                        files, v, done = await self._run(
+                            self._chunk_round, actor, v, max_bytes
+                        )
+                        if files:
+                            await out_q.put(files)
+                    await out_q.put(None)
+            except Exception as e:
+                # the emitter must never block forever on a dead scanner —
+                # deliver the failure in-position and let it re-raise
+                await out_q.put(e)
 
         queues: list[asyncio.Queue] = []
         tasks: list[asyncio.Task] = []
@@ -393,6 +398,8 @@ class FsStorage(Storage):
                     files = await out_q.get()
                     if files is None:
                         break
+                    if isinstance(files, Exception):
+                        raise files
                     for item in files:
                         chunk.append(item)
                         size += len(item[2])

@@ -76,9 +76,14 @@ def decrypt_blob(key: bytes, blob: bytes) -> bytes:
 
 
 def decrypt_blobs(key: bytes, blobs: list, n_threads: int = 0) -> list:
-    """Bulk open: unwrap every EncBox envelope, then one native threaded
-    batch call (GIL released for the whole stripe-parallel decrypt).
-    Raises AeadError if any blob fails authentication."""
+    """Bulk open: parse every EncBox envelope and decrypt, all natively.
+
+    The fast path hands ONE concatenated buffer to C++ — envelope parsing
+    in Python costs more than the decrypt itself at 100k-tiny-file scale.
+    Returns zero-copy memoryviews into one cleartext buffer.  Any
+    structural surprise falls back to the per-blob path below, whose
+    errors name the offending index; authentication failures raise
+    AeadError either way."""
     import numpy as np
 
     _check_key(key)
@@ -88,6 +93,50 @@ def decrypt_blobs(key: bytes, blobs: list, n_threads: int = 0) -> list:
         return []
     if n_threads <= 0:
         n_threads = min(32, os.cpu_count() or 1)
+
+    boffs = np.zeros(n + 1, np.uint64)
+    np.cumsum([len(b) for b in blobs], out=boffs[1:])
+    big = b"".join(blobs)
+    bp, _b = native.in_ptr(big)
+    nonce_offs = np.zeros(n, np.uint64)
+    ct_offs = np.zeros(n, np.uint64)
+    ct_lens = np.zeros(n, np.uint64)
+    vp, _v = native.in_ptr(XCHACHA_DATA_VERSION_1)
+    total_clear = int(lib.encbox_parse_batch(
+        bp, boffs.ctypes.data_as(native.u64p), n, vp,
+        nonce_offs.ctypes.data_as(native.u64p),
+        ct_offs.ctypes.data_as(native.u64p),
+        ct_lens.ctypes.data_as(native.u64p),
+    ))
+    if total_clear >= 0:
+        out_offs = np.zeros(n, np.uint64)
+        np.cumsum(ct_lens[:-1] - TAG_LEN, out=out_offs[1:])
+        op, out = native.out_buf(total_clear)
+        kp, _k = native.in_ptr(key)
+        ok = np.zeros(n, np.uint8)
+        failures = lib.encbox_decrypt_scatter_mt(
+            kp, bp,
+            nonce_offs.ctypes.data_as(native.u64p),
+            ct_offs.ctypes.data_as(native.u64p),
+            ct_lens.ctypes.data_as(native.u64p),
+            n, op,
+            out_offs.ctypes.data_as(native.u64p),
+            ok.ctypes.data_as(native.u8p), n_threads,
+        )
+        if failures:
+            bad = int(np.flatnonzero(ok == 0)[0])
+            raise AeadError(
+                f"authentication failed on {failures}/{n} blobs (first: #{bad})"
+            )
+        view = memoryview(out)  # keeps `out` alive for every slice
+        lens = (ct_lens - TAG_LEN).tolist()
+        res, lo = [], 0
+        for ln in lens:
+            res.append(view[lo : lo + int(ln)])
+            lo += int(ln)
+        return res
+
+    # slow path: per-blob parse with index-precise errors
     nonces = bytearray(NONCE_LEN * n)
     cts = []
     offsets = np.zeros(n + 1, np.uint64)

@@ -630,6 +630,17 @@ class Core:
                     await finish_session()
                     python_mode = True
                 await self._fold_chunk_python(files, clears)
+                # later chunks already in flight were validated ahead of
+                # this one — fold them NOW, in order, or a newer chunk
+                # would fold first and trip the version-gap check
+                while inflight:
+                    t2, _m2, f2, c2 = inflight.pop(0)
+                    t2.cancel()
+                    try:
+                        await t2
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                    await self._fold_chunk_python(f2, c2)
                 return
             self._advance_cursors(metas)
             fed_files += len(files)

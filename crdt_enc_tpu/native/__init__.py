@@ -84,6 +84,15 @@ def _bind(lib) -> None:
         ctypes.c_int,
     ]
     lib.xchacha20poly1305_decrypt_batch_mt.restype = ctypes.c_int
+    lib.encbox_parse_batch.argtypes = [
+        u8p, u64p, ctypes.c_uint64, u8p, u64p, u64p, u64p
+    ]
+    lib.encbox_parse_batch.restype = ctypes.c_int64
+    lib.encbox_decrypt_scatter_mt.argtypes = [
+        u8p, u8p, u64p, u64p, u64p, ctypes.c_uint64, u8p, u64p, u8p,
+        ctypes.c_int,
+    ]
+    lib.encbox_decrypt_scatter_mt.restype = ctypes.c_int
 
     lib.orset_count_rows.argtypes = [u8p, ctypes.c_uint64]
     lib.orset_count_rows.restype = ctypes.c_int64

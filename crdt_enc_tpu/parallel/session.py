@@ -112,6 +112,7 @@ class OrsetFoldSession:
         self.mode = "buffer"
         self._buffered: list[tuple] = []
         self._buffered_bytes = 0
+        self._member_canon: dict[int, bytes] = {}
         self.rows_fed = 0
         # HOST_REDUCE accumulators (allocated at promotion)
         self._h_add = self._h_rm = None
@@ -159,17 +160,30 @@ class OrsetFoldSession:
 
     def _remap_members(self, member_idx, member_objs):
         """Chunk-local member interning → the session-global vocabulary.
-        Python work is one intern per *distinct* member per chunk; rows
-        remap vectorized."""
+        Python work is one intern + one canonical pack per *distinct*
+        member per chunk; rows remap vectorized.
+
+        Collision guard: distinct canonical bytes can still collide as
+        Python values (1 == True, 0.0 == -0.0) — including ACROSS chunks
+        or against members already in the state.  The dense planes cannot
+        represent that, so each vocab slot remembers the canonical bytes
+        it was first interned under and any mismatch declines the chunk
+        (the per-op path then matches the host dict semantics exactly)."""
+        from ..utils import codec
+
+        canon = self._member_canon
         table = np.empty(len(member_objs), np.int32)
         for i, obj in enumerate(member_objs):
-            table[i] = self.members.intern(obj)
-        if len(set(table.tolist())) != len(member_objs):
-            # distinct canonical bytes colliding as Python values
-            # (1 == True): the dense planes cannot represent this —
-            # decline so the caller uses the per-op path (which matches
-            # the host dict semantics exactly)
-            raise SessionDeclined("member vocab collision")
+            gid = self.members.intern(obj)
+            table[i] = gid
+            pk = codec.pack(obj)
+            prev = canon.get(gid)
+            if prev is None:
+                stored = self.members.items[gid]
+                prev = pk if stored is obj else codec.pack(stored)
+                canon[gid] = prev
+            if prev != pk:
+                raise SessionDeclined("member vocab collision")
         return table[member_idx]
 
     # ------------------------------------------------------------- promotion
@@ -194,13 +208,19 @@ class OrsetFoldSession:
             # the donated fold for the new static shape, so fewer, larger
             # steps (the compile cache then amortizes across runs)
             self._d_E = _bucket(max(len(self.members), 1) * 4)
-            clock0, add0, rm0 = self._state_planes(self._d_E)
+            # the device planes seed from ZERO, not from the state: the
+            # ops-only fold is itself a valid ORSet state (stale replays
+            # and deferred removes resolve through the CvRDT merge with
+            # the live state at finish), and never reading the state here
+            # keeps this thread-safe against concurrent applies — this
+            # code runs off the event loop (core drain_one → to_thread)
             import jax
+            import jax.numpy as jnp
 
             self._d_planes = (
-                jax.device_put(clock0),
-                jax.device_put(add0),
-                jax.device_put(rm0),
+                jax.device_put(np.zeros(max(self.R, 1), np.int32)),
+                jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
+                jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
             )
             for cols in self._buffered:
                 self._device_feed(*cols)
@@ -223,6 +243,13 @@ class OrsetFoldSession:
     # ------------------------------------------------- host-reduce internals
     def _grow_host_planes(self) -> None:
         E_new = _bucket(len(self.members))
+        if E_new * self.R > 2 * HOST_PLANE_CELLS:
+            # a member-skewed stream outgrew the promotion-time estimate;
+            # declining (before any mutation) keeps the bounded-memory
+            # contract — the core folds the rest per-op, chunk by chunk
+            raise SessionDeclined(
+                "member vocabulary outgrew the host reduction planes"
+            )
         grow = E_new - self._h_add.shape[0]
         if grow > 0:
             z = np.zeros((grow, self.R), np.int32)

@@ -113,15 +113,18 @@ def test_session_modes_match_host(force_mode, chunk_files):
     )
 
 
-def test_session_into_existing_state_matches_host():
+@pytest.mark.parametrize("force_mode", ["host_reduce", "device_stream"])
+def test_session_into_existing_state_matches_host(force_mode):
     """Folding a tail into a state that already holds a prefix (the
-    snapshot-resume shape)."""
-    host, ops = _history(300, 17, seed=5)
+    snapshot-resume shape) — including removes whose targets live only in
+    the prefix state, which the device path resolves via the CvRDT merge
+    of its zero-seeded ops-only planes."""
+    host, ops = _history(300, 17, seed=5, rm_every=5)
     prefix = ORSet()
     for op in ops[:120]:
         prefix.apply(op)
     folded = _run_session(
-        ops[120:], chunk_files=2, force_mode="host_reduce",
+        ops[120:], chunk_files=2, force_mode=force_mode,
         state=ORSet.from_obj(prefix.to_obj()),
     )
     assert canonical_bytes(folded) == canonical_bytes(host)
@@ -348,3 +351,77 @@ def test_concurrent_new_actor_before_finish():
     folded = session.finish()
     assert folded.contains(b"late-member")
     assert canonical_bytes(folded) == canonical_bytes(host)
+
+
+def test_mid_stream_decline_keeps_version_order():
+    """A chunk the native decoder declines (here: an op whose dot actor
+    appears in no op directory or state) flips the pipeline to per-op
+    folds — chunks already validated and in flight must fold IN ORDER
+    first, or the version-gap check would trip on a newer chunk."""
+
+    async def go():
+        remote = MemoryRemote()
+        producer = await Core.open(make_opts(remote))
+        fake = b"\xbb" * 16  # a dot actor with no op dir: decoder declines
+        for w in range(30):
+            if w == 12:
+                await producer.apply_ops([AddOp(999, Dot(fake, 1))])
+            else:
+                await producer.update(
+                    lambda s, w=w: s.add_ctx(producer.actor_id, w)
+                )
+        host = await Core.open(make_opts(remote))
+        await host.read_remote()
+        reader_opts = make_opts(remote, accel=TpuAccelerator(min_device_batch=1))
+        reader_opts.storage = _chunked_storage(remote, 3)
+        reader = await Core.open(reader_opts)
+        await reader.read_remote()
+        assert reader.with_state(canonical_bytes) == host.with_state(
+            canonical_bytes
+        )
+        assert reader.with_state(lambda s: s.contains(999))
+
+    run(go())
+
+
+def test_scan_error_propagates_not_hangs(tmp_path):
+    """A dead actor scanner must deliver its failure to the chunk emitter,
+    not leave it awaiting a sentinel that never comes."""
+    import os as _os
+
+    import crdt_enc_tpu.backends.fs as fsmod
+    from crdt_enc_tpu import native
+    from crdt_enc_tpu.backends.fs import FsStorage
+
+    async def go():
+        s = FsStorage(str(tmp_path / "l"), str(tmp_path / "remote"))
+        actor = b"\x07" * 16
+        for v in range(1, 8):
+            await s.store_ops(actor, v, bytes([v]) * 30)
+
+        lib = native.load()
+
+        def broken_read(*a):
+            return -1  # force the per-file fallback
+
+        real_rf = fsmod._read_file
+
+        def failing_rf(path):
+            if path.endswith(_os.sep + "4"):
+                raise PermissionError(path)
+            return real_rf(path)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(lib, "read_op_files", broken_read), \
+                mock.patch.object(fsmod, "_read_file", failing_rf):
+            with pytest.raises(PermissionError):
+                chunks = []
+                async for c in s.iter_op_chunks([(actor, 1)]):
+                    chunks.append(c)
+
+    # a hang would block forever; wrap in a timeout to fail loudly instead
+    async def with_timeout():
+        await asyncio.wait_for(go(), timeout=30)
+
+    run(with_timeout())
