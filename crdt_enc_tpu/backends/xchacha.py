@@ -37,6 +37,38 @@ def _check_key(key: bytes) -> None:
         raise AeadError(f"invalid key length {len(key)}; expected {KEY_LEN}")
 
 
+def seal_raw(key: bytes, nonce: bytes, data: bytes) -> bytes:
+    """The bare AEAD: XChaCha20-Poly1305 seal with an explicit nonce,
+    returning ``ct ‖ tag`` (no envelope) — for callers speaking a foreign
+    framing, e.g. the reference-remote importer."""
+    _check_key(key)
+    if len(nonce) != NONCE_LEN:
+        raise AeadError(f"invalid nonce length {len(nonce)}")
+    lib = native.load()
+    kp, _k = native.in_ptr(key)
+    np_, _n = native.in_ptr(nonce)
+    pp, _p = native.in_ptr(data)
+    op, out = native.out_buf(len(data) + TAG_LEN)
+    lib.xchacha20poly1305_encrypt(kp, np_, None, 0, pp, len(data), op)
+    return out.tobytes()
+
+
+def open_raw(key: bytes, nonce: bytes, ct: bytes) -> bytes:
+    """Inverse of :func:`seal_raw`; raises AeadError on tag mismatch."""
+    _check_key(key)
+    if len(nonce) != NONCE_LEN or len(ct) < TAG_LEN:
+        raise AeadError("malformed nonce/ciphertext")
+    lib = native.load()
+    kp, _k = native.in_ptr(key)
+    np_, _n = native.in_ptr(nonce)
+    cp, _c = native.in_ptr(ct)
+    op, out = native.out_buf(len(ct) - TAG_LEN)
+    rc = lib.xchacha20poly1305_decrypt(kp, np_, None, 0, cp, len(ct), op)
+    if rc != 0:
+        raise AeadError("authentication failed (wrong key or tampered data)")
+    return out.tobytes()
+
+
 def encrypt_blob(key: bytes, data: bytes) -> bytes:
     """Synchronous seal: data → raw-serialized versioned EncBox envelope."""
     _check_key(key)
@@ -80,10 +112,15 @@ def decrypt_blobs(key: bytes, blobs: list, n_threads: int = 0) -> list:
 
     The fast path hands ONE concatenated buffer to C++ — envelope parsing
     in Python costs more than the decrypt itself at 100k-tiny-file scale.
-    Returns zero-copy memoryviews into one cleartext buffer.  Any
-    structural surprise falls back to the per-blob path below, whose
+    Any structural surprise falls back to the per-blob path below, whose
     errors name the offending index; authentication failures raise
-    AeadError either way."""
+    AeadError either way.
+
+    Returns a list of **memoryviews** (both paths, so callers can't come
+    to depend on bytes by accident): zero-copy slices of one shared
+    cleartext buffer.  Treat them as transient — each view pins the whole
+    buffer, and they are unhashable — and ``bytes(view)`` anything you
+    keep."""
     import numpy as np
 
     _check_key(key)
@@ -184,7 +221,7 @@ def decrypt_blobs(key: bytes, blobs: list, n_threads: int = 0) -> list:
     for i in range(n):
         lo = int(out_offsets[i])
         hi = lo + (int(offsets[i + 1] - offsets[i]) - TAG_LEN)
-        res.append(out[lo:hi].tobytes())
+        res.append(memoryview(out)[lo:hi])
     return res
 
 

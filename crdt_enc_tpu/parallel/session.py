@@ -215,7 +215,6 @@ class OrsetFoldSession:
             # keeps this thread-safe against concurrent applies — this
             # code runs off the event loop (core drain_one → to_thread)
             import jax
-            import jax.numpy as jnp
 
             self._d_planes = (
                 jax.device_put(np.zeros(max(self.R, 1), np.int32)),

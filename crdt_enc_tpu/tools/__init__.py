@@ -1,0 +1,1 @@
+"""Operational tools: migration and maintenance utilities around the core."""

@@ -1,0 +1,279 @@
+"""Reference-remote importer: golden fixtures in the reference's wire
+format (synthesized byte-layer-exact from its in-tree serialization code —
+see the layer citations in tools/import_reference.py) round-trip through
+import → read_remote → compact in this framework.
+
+Fixture layers per the reference source:
+* outer: raw VersionBytes = CURRENT_VERSION uuid bytes ‖ payload
+  (crdt-enc/src/lib.rs:26, 695; version_bytes.rs:198-208)
+* cipher: rmp to_vec_named of VersionBytesRef(DATA_VERSION, EncBox) —
+  tuple struct → msgpack array, uuid → bin16, EncBox named struct →
+  {"nonce": bin24, "enc_data": bin} (xchacha lib.rs:59-68)
+* inner: raw VersionBytes(app data version) ‖ rmp(Vec<Op>)
+  (lib.rs:670-671)
+* op dirs: actor uuid Display form, files from version 0
+  (crdt-enc-tokio lib.rs:249-257; lib.rs:697-716)
+"""
+
+import asyncio
+import secrets
+import uuid as uuidm
+
+import pytest
+
+from crdt_enc_tpu.backends import (
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+    XChaChaCryptor,
+    FsStorage,
+)
+from crdt_enc_tpu.backends.xchacha import seal_raw
+from crdt_enc_tpu.core import Core, OpenOptions, mvreg_adapter
+from crdt_enc_tpu.models import MVReg, canonical_bytes
+from crdt_enc_tpu.tools.import_reference import (
+    REF_CIPHER_DATA_VERSION,
+    REF_CONTAINER_VERSION,
+    ReferenceFormatError,
+    import_reference_remote,
+    mvreg_translator,
+    open_reference_blob,
+)
+from crdt_enc_tpu.utils import codec
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+APP_DATA_VERSION = uuidm.UUID("11111111-2222-3333-4444-555555555555").bytes
+
+ACTOR_A = uuidm.UUID(int=0xA).bytes
+ACTOR_B = uuidm.UUID(int=0xB).bytes
+ACTOR_C = uuidm.UUID(int=0xC).bytes
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- fixture synthesis (the reference's exact layering) -------------------
+
+
+def ref_seal(key: bytes, payload: bytes, data_version=APP_DATA_VERSION) -> bytes:
+    inner = data_version + payload
+    nonce = secrets.token_bytes(24)
+    enc_box = codec.pack({"nonce": nonce, "enc_data": seal_raw(key, nonce, inner)})
+    middle = codec.pack([REF_CIPHER_DATA_VERSION, enc_box])
+    return REF_CONTAINER_VERSION + middle
+
+
+def ref_mvreg_op(clock: dict, val, named=True):
+    """crdts v7 mvreg::Op { clock, val } — named-map (to_vec_named) or
+    positional encodings."""
+    clk = {"dots": dict(clock)} if named else list([dict(clock)])[0]
+    return {"clock": clk, "val": val} if named else [dict(clock), val]
+
+
+def write_ref_remote(root, key, files_by_actor):
+    """files_by_actor: {actor_bytes: [ [op, ...] per file ]} — written in
+    the reference layout (Display-named dirs, versions from 0)."""
+    for actor, files in files_by_actor.items():
+        d = root / "ops" / str(uuidm.UUID(bytes=actor))
+        d.mkdir(parents=True)
+        for v, ops in enumerate(files):
+            (d / str(v)).write_bytes(ref_seal(key, codec.pack(ops)))
+
+
+def make_dest(tmp_path, name="dest"):
+    return OpenOptions(
+        storage=FsStorage(str(tmp_path / name / "local"), str(tmp_path / name / "remote")),
+        cryptor=XChaChaCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=mvreg_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=True,
+    )
+
+
+# ---- blob-level ------------------------------------------------------------
+
+
+def test_open_reference_blob_roundtrip():
+    key = secrets.token_bytes(32)
+    payload = codec.pack([ref_mvreg_op({ACTOR_A: 1}, 7)])
+    blob = ref_seal(key, payload)
+    ver, out = open_reference_blob(key, blob)
+    assert ver == APP_DATA_VERSION
+    assert bytes(out) == payload
+
+
+def test_open_reference_blob_rejects_wrong_key_and_formats():
+    key = secrets.token_bytes(32)
+    blob = ref_seal(key, b"x")
+    from crdt_enc_tpu.backends.xchacha import AeadError
+
+    with pytest.raises(AeadError):
+        open_reference_blob(secrets.token_bytes(32), blob)
+    with pytest.raises(ReferenceFormatError):
+        open_reference_blob(key, b"\x00" * 40)  # wrong container uuid
+    tampered = blob[:16] + codec.pack([APP_DATA_VERSION, b"junk"])
+    with pytest.raises(ReferenceFormatError):
+        open_reference_blob(key, tampered)  # wrong cipher version
+
+
+def test_mvreg_translator_accepts_both_encodings():
+    named = codec.pack([ref_mvreg_op({ACTOR_A: 3}, 42, named=True)])
+    positional = codec.pack([ref_mvreg_op({ACTOR_A: 3}, 42, named=False)])
+    for payload in (named, positional):
+        (op,) = mvreg_translator(payload)
+        assert op.value == 42
+        assert op.clock.get(ACTOR_A) == 3
+
+
+# ---- end-to-end ------------------------------------------------------------
+
+
+def test_import_reference_remote_end_to_end(tmp_path):
+    """Three reference actors with a write history including dominated and
+    concurrent register writes; import → fold → compact → fresh replica
+    re-joins from the snapshot alone."""
+    key = secrets.token_bytes(32)
+    src = tmp_path / "ref-remote"
+    # A writes 1 (clock {A:1}); B overwrites with 2 ({A:1,B:1});
+    # C writes 3 concurrently with B ({A:1,C:1}) → values {2, 3} survive
+    write_ref_remote(src, key, {
+        ACTOR_A: [[ref_mvreg_op({ACTOR_A: 1}, 1)]],
+        ACTOR_B: [[ref_mvreg_op({ACTOR_A: 1, ACTOR_B: 1}, 2)]],
+        ACTOR_C: [[ref_mvreg_op({ACTOR_A: 1, ACTOR_C: 1}, 3)]],
+    })
+
+    async def go():
+        dest = await Core.open(make_dest(tmp_path))
+        stats = await import_reference_remote(src, dest, key, compact=True)
+        assert stats.actors == 3 and stats.op_files == 3 and stats.ops == 3
+        assert stats.data_versions == {APP_DATA_VERSION}
+        assert sorted(dest.with_state(lambda s: s.read().values)) == [2, 3]
+
+        # the snapshot alone carries the imported history
+        fresh2 = await Core.open(OpenOptions(
+            storage=FsStorage(
+                str(tmp_path / "fresh2"), str(tmp_path / "dest" / "remote")
+            ),
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=mvreg_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        ))
+        await fresh2.read_remote()
+        assert fresh2.with_state(canonical_bytes) == dest.with_state(
+            canonical_bytes
+        )
+
+    run(go())
+
+
+def test_import_multi_file_histories_and_version_shift(tmp_path):
+    """Multi-file per-actor logs (reference versions 0,1,2…) must land
+    densely at destination versions 1,2,3… and fold in order."""
+    key = secrets.token_bytes(32)
+    src = tmp_path / "ref-remote"
+    write_ref_remote(src, key, {
+        ACTOR_A: [
+            [ref_mvreg_op({ACTOR_A: 1}, 10)],
+            [ref_mvreg_op({ACTOR_A: 2}, 11)],
+            [ref_mvreg_op({ACTOR_A: 3}, 12), ref_mvreg_op({ACTOR_A: 4}, 13)],
+        ],
+    })
+
+    async def go():
+        dest = await Core.open(make_dest(tmp_path))
+        stats = await import_reference_remote(src, dest, key)
+        assert stats.op_files == 3 and stats.ops == 4
+        # dominated writes resolved: only the latest survives
+        assert dest.with_state(lambda s: s.read().values) == [13]
+        # dest remote holds the imported files at versions 1..3
+        names = sorted(
+            int(n) for n in
+            __import__("os").listdir(
+                tmp_path / "dest" / "remote" / "ops" / ACTOR_A.hex()
+            )
+            if not n.startswith(".")
+        )
+        assert names == [1, 2, 3]
+
+    run(go())
+
+
+def test_import_skips_reference_states_and_warns(tmp_path, caplog):
+    key = secrets.token_bytes(32)
+    src = tmp_path / "ref-remote"
+    write_ref_remote(src, key, {ACTOR_A: [[ref_mvreg_op({ACTOR_A: 1}, 5)]]})
+    (src / "states").mkdir()
+    (src / "states" / "somehash").write_bytes(b"unreadable by design")
+
+    async def go():
+        dest = await Core.open(make_dest(tmp_path))
+        with caplog.at_level("WARNING"):
+            stats = await import_reference_remote(src, dest, key)
+        assert stats.skipped_states == 1
+        assert any("SURVEY.md" in r.message for r in caplog.records)
+        assert dest.with_state(lambda s: s.read().values) == [5]
+
+    run(go())
+
+
+def test_import_cli(tmp_path, capsys):
+    from crdt_enc_tpu.tools.import_reference import main
+
+    key = secrets.token_bytes(32)
+    src = tmp_path / "ref-remote"
+    write_ref_remote(src, key, {
+        ACTOR_A: [[ref_mvreg_op({ACTOR_A: 1}, 5)]],
+        ACTOR_B: [[ref_mvreg_op({ACTOR_A: 1, ACTOR_B: 1}, 6)]],
+    })
+    rc = main([
+        str(src), str(tmp_path / "d-local"), str(tmp_path / "d-remote"),
+        "--key-hex", key.hex(), "--compact",
+    ])
+    assert rc == 0
+    assert "imported 2 ops in 2 files from 2 actors" in capsys.readouterr().out
+
+    async def check():
+        reader = await Core.open(OpenOptions(
+            storage=FsStorage(str(tmp_path / "r"), str(tmp_path / "d-remote")),
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=mvreg_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        ))
+        await reader.read_remote()
+        assert reader.with_state(lambda s: s.read().values) == [6]
+
+    run(check())
+
+
+def test_import_refuses_gapped_history(tmp_path):
+    """A missing version file with later files present means the source log
+    is not dense — the importer must refuse, never silently truncate."""
+    import os as _os
+
+    key = secrets.token_bytes(32)
+    src = tmp_path / "ref-remote"
+    write_ref_remote(src, key, {
+        ACTOR_A: [
+            [ref_mvreg_op({ACTOR_A: 1}, 1)],
+            [ref_mvreg_op({ACTOR_A: 2}, 2)],
+            [ref_mvreg_op({ACTOR_A: 3}, 3)],
+        ],
+    })
+    _os.remove(src / "ops" / str(uuidm.UUID(bytes=ACTOR_A)) / "1")
+
+    async def go():
+        dest = await Core.open(make_dest(tmp_path))
+        with pytest.raises(ReferenceFormatError, match="gap"):
+            await import_reference_remote(src, dest, key)
+
+    run(go())
