@@ -3,8 +3,10 @@
 Given V candidate values with dense clocks ``(V, R)``, keep each value whose
 clock is not strictly dominated by another candidate's clock — the CvRDT
 merge rule of crdt_enc_tpu/models/mvreg.py, O(V²R) pairwise but fully
-parallel.  V is small in practice (concurrent writers), so this exists for
-completeness and for the batched metadata-merge path, not throughput.
+parallel.  Production caller: ``TpuAccelerator._merge_mvregs`` collapses a
+whole batch of MVReg snapshots (compaction over a register state type) to
+the global anti-chain in one call once the candidate count clears the
+dispatch threshold; below it the host pairwise merge wins.
 """
 
 from __future__ import annotations

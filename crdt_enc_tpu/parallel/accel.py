@@ -33,8 +33,10 @@ def _bucket(n: int, floor: int = 8) -> int:
 
 
 class TpuAccelerator(HostAccelerator):
-    """Accelerates ORSet / G-Counter / PN-Counter / LWW-Map; anything else
-    (MVReg, EmptyCrdt, custom types) falls back to the host loops.
+    """Accelerates ORSet / G-Counter / PN-Counter / LWW-Map folds and
+    ORSet / MVReg merges; anything else (EmptyCrdt, custom types — and
+    any batch too small to beat dispatch overhead) falls back to the
+    host loops.
 
     ``mesh``: an optional ``jax.sharding.Mesh`` with ``(dp, mp)`` axes
     (``parallel.mesh.make_mesh`` / ``distributed.make_multihost_mesh``).
@@ -42,9 +44,20 @@ class TpuAccelerator(HostAccelerator):
     sharded SPMD kernels — op rows over ``dp``, state planes over ``mp`` —
     so ``Core.compact`` executes multi-chip, not on device 0 of a pod."""
 
-    def __init__(self, min_device_batch: int = MIN_DEVICE_BATCH, mesh=None):
+    def __init__(
+        self,
+        min_device_batch: int = MIN_DEVICE_BATCH,
+        mesh=None,
+        sparse_device: bool = False,
+    ):
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # sparse-regime folds default to the vectorized host sort (numpy
+        # lexsort beats the TPU's bitonic sort ~25× at these shapes and no
+        # planes exist to ship — see orset_fold_sparse_host).  Opt in to
+        # the device COO kernel where that trade flips: columns already
+        # device-resident, or hosts much slower than this one.
+        self.sparse_device = sparse_device
 
     def _mesh_active(self) -> bool:
         return self.mesh is not None and self.mesh.size > 1
@@ -115,6 +128,10 @@ class TpuAccelerator(HostAccelerator):
                 state, kind, member, actor, counter, members, replicas
             )
         if self._use_sparse(E, R, n_rows):
+            if self.sparse_device and 2 * E * R < 2**31:
+                return self._fold_orset_coo_device(
+                    state, kind, member, actor, counter, members, replicas
+                )
             # vectorized host fold: in the N ≪ E·R regime the work is one
             # sort, where numpy beats the TPU's bitonic sort ~25x and no
             # dense planes exist to ship (see orset_fold_sparse_host docs).
@@ -162,6 +179,35 @@ class TpuAccelerator(HostAccelerator):
         state.entries = folded.entries
         state.deferred = folded.deferred
         return state
+
+    def _fold_orset_coo_device(
+        self, state: ORSet, kind, member, actor, counter, members, replicas
+    ) -> ORSet:
+        """Sparse-regime device fold: the sorted-COO kernel aggregates the
+        batch on device without dense planes; the sparse state writeback
+        shares ``orset_apply_coo`` with the host twin, so the two paths
+        cannot drift."""
+        # dense clock FIRST: it may intern clock actors into `replicas`,
+        # and the kernel's segment keys are encoded modulo the final R
+        clock0 = K.vclock_to_dense(state.clock, replicas)
+        E, R = len(members), len(replicas)
+        cols = K.OrsetColumns(
+            np.asarray(kind, np.int8),
+            np.asarray(member, np.int32),
+            np.asarray(actor, np.int32),
+            np.asarray(counter, np.int32),
+            members,
+            replicas,
+        )
+        K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
+        clock, skey, smax, is_max = K.orset_fold_coo(
+            clock0, cols.kind, cols.member, cols.actor, cols.counter,
+            num_members=E, num_replicas=R,
+        )
+        return K.orset_apply_coo(
+            state, np.asarray(clock), np.asarray(skey), np.asarray(smax),
+            np.asarray(is_max), members, replicas,
+        )
 
     def _fold_orset_sharded(
         self, state: ORSet, kind, member, actor, counter, members, replicas
@@ -441,7 +487,48 @@ class TpuAccelerator(HostAccelerator):
                 return self._merge_orsets_sharded(state, others)
             if len(others) + 1 >= 3:
                 return self._merge_orsets(state, others)
+        from ..models import MVReg
+
+        if isinstance(state, MVReg):
+            total = len(state.vals) + sum(len(o.vals) for o in others)
+            if total >= self.min_device_batch:
+                return self._merge_mvregs(state, others)
         return super().merge_states(state, others)
+
+    def _merge_mvregs(self, state, others: list):
+        """Batched MVReg snapshot merge: the global anti-chain of every
+        candidate (clock, value) pair via ONE dominance-filter kernel
+        call, instead of S sequential pairwise merges.  Equivalent
+        because each input register is already an anti-chain and
+        domination is transitive, so iterated pairwise merging and the
+        global filter both keep exactly the pairs no other pair strictly
+        dominates; identical duplicates never dominate each other
+        (strict filter) and collapse in canonicalization."""
+        pairs = list(state.vals)
+        for o in others:
+            pairs.extend(o.vals)
+        replicas = K.Vocab()
+        for c, _ in pairs:
+            for a in c.counters:
+                replicas.intern(a)
+        R, V = len(replicas), len(pairs)
+        if R == 0 or V <= 1:  # empty clocks: dedup is all there is
+            state.vals = pairs
+            state._canonicalize()
+            return state
+        # bucket-pad both axes so repeated merges reuse the compiled
+        # program: zero rows are masked out via `valid`, zero columns are
+        # inert (elementwise comparisons on equal zeros)
+        clocks = np.zeros((_bucket(V), _bucket(R)), np.int32)
+        for i, (c, _) in enumerate(pairs):
+            for a, n in c.counters.items():
+                clocks[i, replicas.intern(a)] = n
+        valid = np.zeros(len(clocks), bool)
+        valid[:V] = True
+        keep = np.asarray(K.mvreg_dominance_keep(clocks, valid))
+        state.vals = [pairs[i] for i in np.flatnonzero(keep[:V])]
+        state._canonicalize()
+        return state
 
     def _merge_orsets_sharded(self, state: ORSet, others: list) -> ORSet:
         """Pairwise SPMD merges with planes sharded over mp — elementwise

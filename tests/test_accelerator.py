@@ -247,3 +247,72 @@ def test_sparse_fold_property_random_histories():
         assert canonical_bytes(s) == canonical_bytes(host2)
 
     inner()
+
+
+def test_sparse_device_coo_route_matches_host():
+    """sparse_device=True routes sparse-regime folds through the device
+    COO kernel (orset_fold_coo) — byte-equal to both the host loop and
+    the default host-sort route."""
+    import numpy as np
+
+    from crdt_enc_tpu.models import ORSet, canonical_bytes
+    from crdt_enc_tpu.parallel import TpuAccelerator
+
+    rng = np.random.default_rng(31)
+    actors = [bytes([i + 1]) * 16 for i in range(6)]
+    host = ORSet()
+    ops = []
+    for i in range(400):
+        a = actors[int(rng.integers(len(actors)))]
+        m = int(rng.integers(500))
+        if i % 6 == 5 and host.contains(m):
+            op = host.rm_ctx(m)
+        else:
+            op = host.add_ctx(a, m)
+        host.apply(op)
+        ops.append(op)
+
+    def run(accel):
+        s = ORSet()
+        # force the sparse regime at this small test shape
+        accel.SPARSE_MIN_CELLS = 1
+        accel.SPARSE_CELLS_PER_ROW = 0
+        accel.min_device_batch = 1
+        return accel.fold_ops(s, list(ops))
+
+    via_host_sort = run(TpuAccelerator())
+    via_device_coo = run(TpuAccelerator(sparse_device=True))
+    assert canonical_bytes(via_host_sort) == canonical_bytes(host)
+    assert canonical_bytes(via_device_coo) == canonical_bytes(host)
+
+
+def test_mvreg_batched_dominance_merge_matches_host():
+    """The accelerator's batched MVReg merge (mvreg_dominance_keep) must
+    equal sequential host merges on dominated + concurrent + duplicate
+    register snapshots."""
+    from crdt_enc_tpu.models import MVReg, canonical_bytes
+    from crdt_enc_tpu.parallel import TpuAccelerator
+
+    actors = [bytes([i + 1]) * 16 for i in range(4)]
+    base = MVReg()
+    base.apply(base.write_ctx(actors[0], b"v0"))
+
+    snaps = []
+    for i, a in enumerate(actors):
+        s = MVReg.from_obj(base.to_obj())
+        s.apply(s.write_ctx(a, b"w%d" % i))  # concurrent successors of v0
+        snaps.append(s)
+    snaps.append(MVReg.from_obj(base.to_obj()))  # dominated snapshot
+    snaps.append(MVReg.from_obj(snaps[0].to_obj()))  # exact duplicate
+
+    host = MVReg.from_obj(base.to_obj())
+    for s in snaps:
+        host.merge(MVReg.from_obj(s.to_obj()))
+
+    accel = TpuAccelerator(min_device_batch=1)
+    batched = MVReg.from_obj(base.to_obj())
+    accel.merge_states(batched, [MVReg.from_obj(s.to_obj()) for s in snaps])
+    assert canonical_bytes(batched) == canonical_bytes(host)
+    assert sorted(bytes(v) for v in batched.read().values) == [
+        b"w0", b"w1", b"w2", b"w3",
+    ]

@@ -1,0 +1,286 @@
+"""Differential Orswot goldens: the crdts-v7 edge cases as explicit
+expected-state fixtures.
+
+The reference delegates set semantics to the external ``crdts`` crate v7
+(Orswot with per-entry causal birth contexts; observable at
+crdt-enc/src/lib.rs:460-466 `state.merge`, lib.rs:533-539 `state.apply`,
+and the Keys CRDT's add-ctx protocol at key_cryptor.rs:72-82).  This
+framework re-designed the representation tombstone-free (dense planes,
+models/orset.py) — these fixtures pin that the OBSERVABLE behavior on the
+crate's nasty cases is the Orswot behavior, with the expected outcome of
+every case written out explicitly and justified, and verified on:
+
+* the host model (per-op apply + CvRDT merge),
+* the dense device fold (``ops.orset_fold`` → planes → state),
+* the sparse host fold twin (``ops.orset_fold_sparse_host``),
+* the device CvRDT merge (``ops.orset_merge``) for the merge cases.
+
+Every case also checks merge commutativity and idempotence on its
+states — order must never show in the canonical bytes.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_enc_tpu import ops as K
+from crdt_enc_tpu.models import ORSet, canonical_bytes
+from crdt_enc_tpu.models.orset import AddOp, RmOp
+from crdt_enc_tpu.models.vclock import Dot, VClock
+
+A, B, C = b"\x0a" * 16, b"\x0b" * 16, b"\x0c" * 16
+
+
+# ---- harness ---------------------------------------------------------------
+
+
+def fold_host(ops, base=None):
+    s = ORSet() if base is None else ORSet.from_obj(base.to_obj())
+    for op in ops:
+        s.apply(op)
+    return s
+
+
+def fold_dense(ops, base=None):
+    """The device fold path: columns → orset_fold → planes → state."""
+    base = ORSet() if base is None else base
+    members, replicas = K.Vocab(), K.Vocab()
+    cols = K.orset_ops_to_columns(ops, members, replicas)
+    K.orset_scan_vocab(base, members, replicas)
+    E, R = len(members), len(replicas)
+    clock0, add0, rm0 = K.orset_state_to_planes(base, members, replicas, scanned=True)
+    clock, add, rm = K.orset_fold(
+        clock0, add0, rm0, cols.kind, cols.member, cols.actor, cols.counter,
+        num_members=E, num_replicas=R,
+    )
+    return K.orset_planes_to_state(
+        np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+    )
+
+
+def fold_sparse(ops, base=None):
+    """The sparse host fold twin."""
+    base = ORSet() if base is None else ORSet.from_obj(base.to_obj())
+    members, replicas = K.Vocab(), K.Vocab()
+    cols = K.orset_ops_to_columns(ops, members, replicas)
+    K.orset_scan_vocab(base, members, replicas)
+    return K.orset_fold_sparse_host(
+        base, cols.kind, cols.member, cols.actor, cols.counter, members, replicas
+    )
+
+
+FOLDS = [("host", fold_host), ("dense", fold_dense), ("sparse", fold_sparse)]
+
+
+def merge_host(a, b):
+    out = ORSet.from_obj(a.to_obj())
+    out.merge(ORSet.from_obj(b.to_obj()))
+    return out
+
+
+def merge_device(a, b):
+    members, replicas = K.Vocab(), K.Vocab()
+    K.orset_scan_vocab(a, members, replicas)
+    K.orset_scan_vocab(b, members, replicas)
+    pa = K.orset_state_to_planes(a, members, replicas, scanned=True)
+    pb = K.orset_state_to_planes(b, members, replicas, scanned=True)
+    clock, add, rm = K.orset_merge(*pa, *pb)
+    return K.orset_planes_to_state(
+        np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+    )
+
+
+MERGES = [("host", merge_host), ("device", merge_device)]
+
+
+def expect_state(clock: dict, entries: dict, deferred: dict) -> ORSet:
+    s = ORSet()
+    s.clock = VClock(dict(clock))
+    s.entries = {m: dict(v) for m, v in entries.items()}
+    s.deferred = {m: dict(v) for m, v in deferred.items()}
+    return s
+
+
+def assert_merge_laws(a, b, expected):
+    """Both merge orders and self-merge must land on the expected bytes."""
+    for name, merge in MERGES:
+        ab = merge(a, b)
+        ba = merge(b, a)
+        assert canonical_bytes(ab) == canonical_bytes(expected), (name, "a⊔b")
+        assert canonical_bytes(ba) == canonical_bytes(expected), (name, "b⊔a")
+        assert canonical_bytes(merge(ab, ab)) == canonical_bytes(expected), (
+            name, "idempotence",
+        )
+
+
+# ---- case 1: deferred remove with ctx beyond the clock --------------------
+
+
+@pytest.mark.parametrize("fold_name,fold", FOLDS)
+def test_deferred_remove_beyond_clock(fold_name, fold):
+    """crdts Orswot: `rm` with a ctx the local clock hasn't seen is
+    DEFERRED — it must not error, must not remove prematurely, and must
+    kill exactly the observed dots when they arrive.
+
+    B removes "m" having observed A's dot 5; this replica has seen
+    nothing from A.  Expected: "m" absent, the horizon {A:5} pending.
+    Then A's dots arrive: dot 5 is born dead (covered); dot 6 survives
+    (observed-remove removes only observed dots — add-wins beyond)."""
+    rm_only = [RmOp(b"m", VClock({A: 5}))]
+    expected_pending = expect_state(
+        clock={}, entries={}, deferred={b"m": {A: 5}}
+    )
+    got = fold(rm_only)
+    assert canonical_bytes(got) == canonical_bytes(expected_pending), fold_name
+
+    # the observed dot arrives later: dead on arrival (per-actor dot order
+    # means dot 5 for "m" is the dot the remove observed)
+    caught_up = rm_only + [AddOp(b"m", Dot(A, 5))]
+    expected_covered = expect_state(
+        clock={A: 5}, entries={}, deferred={}
+    )
+    got = fold(caught_up)
+    assert canonical_bytes(got) == canonical_bytes(expected_covered), fold_name
+
+    # a dot BEYOND the horizon wins (add-wins for unobserved dots)
+    readd = caught_up + [AddOp(b"m", Dot(A, 6))]
+    expected_readd = expect_state(
+        clock={A: 6}, entries={b"m": {A: 6}}, deferred={}
+    )
+    got = fold(readd)
+    assert canonical_bytes(got) == canonical_bytes(expected_readd), fold_name
+
+
+def test_deferred_remove_via_merge_of_disjoint_states():
+    """The deferred horizon must also resolve through the CvRDT merge:
+    state X holds only the pending remove, state Y holds A's add of the
+    same dot — their merge kills the entry (crdts' deferred-remove
+    apply-on-merge behavior)."""
+    x = fold_host([RmOp(b"m", VClock({A: 5}))])
+    y = fold_host([AddOp(b"m", Dot(A, i)) for i in range(1, 6)])
+    expected = expect_state(clock={A: 5}, entries={}, deferred={})
+    assert_merge_laws(x, y, expected)
+
+
+# ---- case 2: concurrent add/remove across 3 replicas ----------------------
+
+
+@pytest.mark.parametrize("fold_name,fold", FOLDS)
+def test_concurrent_add_remove_three_replicas(fold_name, fold):
+    """A adds "m"; B removes it observing A's dot; C adds "m"
+    concurrently (its own dot).  Orswot add-wins: the remove kills only
+    the OBSERVED dot (A:1) — C's unobserved dot survives, so "m" is
+    present with exactly C's birth dot."""
+    ops = [
+        AddOp(b"m", Dot(A, 1)),
+        RmOp(b"m", VClock({A: 1})),  # B's remove, observed {A:1} only
+        AddOp(b"m", Dot(C, 1)),  # concurrent with the remove
+    ]
+    expected = expect_state(
+        clock={A: 1, C: 1}, entries={b"m": {C: 1}}, deferred={}
+    )
+    got = fold(ops)
+    assert canonical_bytes(got) == canonical_bytes(expected), fold_name
+
+
+def test_concurrent_add_remove_three_replicas_via_merge():
+    """Same scenario through three independent replica states merged in
+    every order — the replica boundary must not change the outcome."""
+    sa = fold_host([AddOp(b"m", Dot(A, 1))])
+    sb = merge_host(sa, ORSet())  # B saw A's add…
+    sb.apply(RmOp(b"m", VClock({A: 1})))  # …and removed it
+    sc = fold_host([AddOp(b"m", Dot(C, 1))])  # C never saw A or B
+
+    expected = expect_state(
+        clock={A: 1, C: 1}, entries={b"m": {C: 1}}, deferred={}
+    )
+    for x, y, z in [(sa, sb, sc), (sc, sb, sa), (sb, sc, sa)]:
+        for name, merge in MERGES:
+            got = merge(merge(x, y), z)
+            assert canonical_bytes(got) == canonical_bytes(expected), (
+                name, "order",
+            )
+
+
+# ---- case 3: re-add after observed remove ---------------------------------
+
+
+@pytest.mark.parametrize("fold_name,fold", FOLDS)
+def test_readd_after_observed_remove(fold_name, fold):
+    """A adds (A:1); B removes observing {A:1}; A re-adds with a fresh
+    dot (A:2).  The re-add must survive — its dot was never observed by
+    the remove — and the old dot must not resurrect."""
+    ops = [
+        AddOp(b"m", Dot(A, 1)),
+        RmOp(b"m", VClock({A: 1})),
+        AddOp(b"m", Dot(A, 2)),
+    ]
+    expected = expect_state(
+        clock={A: 2}, entries={b"m": {A: 2}}, deferred={}
+    )
+    got = fold(ops)
+    assert canonical_bytes(got) == canonical_bytes(expected), fold_name
+
+
+def test_removed_entry_does_not_resurrect_on_stale_merge():
+    """Clock-filter regression: a replica that removed "m" (clock covers
+    the dot, entry gone) merged with a STALE replica still holding the
+    dot must keep "m" absent — the stale holder's dot is 'seen but not
+    held' on the fresh side, so it is dead (the tombstone-free design's
+    core claim: the clock IS the tombstone)."""
+    fresh = fold_host([AddOp(b"m", Dot(A, 1)), RmOp(b"m", VClock({A: 1}))])
+    stale = fold_host([AddOp(b"m", Dot(A, 1))])
+    expected = expect_state(clock={A: 1}, entries={}, deferred={})
+    assert_merge_laws(fresh, stale, expected)
+
+
+# ---- case 4: merge of disjoint-clock states -------------------------------
+
+
+def test_merge_disjoint_clock_states():
+    """States with non-overlapping actors and members: the merge is the
+    plain union — nothing is filtered because neither clock covers the
+    other's dots."""
+    x = fold_host([AddOp(b"x", Dot(A, 1)), AddOp(b"both", Dot(A, 2))])
+    y = fold_host([AddOp(b"y", Dot(B, 1)), AddOp(b"both", Dot(B, 2))])
+    expected = expect_state(
+        clock={A: 2, B: 2},
+        entries={b"x": {A: 1}, b"both": {A: 2, B: 2}, b"y": {B: 1}},
+        deferred={},
+    )
+    assert_merge_laws(x, y, expected)
+
+
+def test_merge_disjoint_with_foreign_deferred_horizon():
+    """A deferred horizon for an actor the OTHER side owns: X defers a
+    remove observing B's dot 3; Y has B's dots 1..2 only.  The merge must
+    keep the horizon pending (Y hasn't caught up) and still kill B's
+    held dots ≤ 3."""
+    x = fold_host([RmOp(b"m", VClock({B: 3}))])
+    y = fold_host([AddOp(b"m", Dot(B, 1)), AddOp(b"k", Dot(B, 2))])
+    expected = expect_state(
+        clock={B: 2},
+        entries={b"k": {B: 2}},
+        deferred={b"m": {B: 3}},  # horizon still ahead of the clock
+    )
+    assert_merge_laws(x, y, expected)
+
+
+# ---- the keys-CRDT usage shape (key_cryptor.rs:72-82) ---------------------
+
+
+@pytest.mark.parametrize("fold_name,fold", FOLDS)
+def test_add_ctx_protocol_shape(fold_name, fold):
+    """The reference's only first-party Orswot user is the Keys CRDT:
+    every insert is `add_ctx` (derive dot from the local read ctx) and
+    keys are never removed.  Grow-only inserts from concurrent actors
+    must union losslessly."""
+    s1 = ORSet()
+    ops = []
+    for i, actor in enumerate([A, B, A, C, B]):
+        op = s1.add_ctx(actor, b"key-%d" % i)
+        s1.apply(op)
+        ops.append(op)
+    expected_members = [b"key-%d" % i for i in range(5)]
+    got = fold(ops)
+    assert got.members() == expected_members, fold_name
+    assert canonical_bytes(got) == canonical_bytes(s1), fold_name
