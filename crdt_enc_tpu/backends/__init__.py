@@ -1,4 +1,5 @@
 from .fs import FsStorage
+from .gpg_keys import GpgError, GpgKeyCryptor, NotDecryptable, gpg_available
 from .identity_crypto import IdentityCryptor
 from .memory import MemoryRemote, MemoryStorage, content_name
 from .passphrase_keys import PassphraseKeyCryptor, WrongPassphrase
@@ -32,7 +33,11 @@ def __dir__():
 __all__ = [
     "AeadError",
     "FsStorage",
+    "GpgError",
+    "GpgKeyCryptor",
     "IdentityCryptor",
+    "NotDecryptable",
+    "gpg_available",
     "MemoryRemote",
     "MemoryStorage",
     "PassphraseKeyCryptor",

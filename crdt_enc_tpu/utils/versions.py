@@ -40,6 +40,14 @@ SUPPORTED_PASSPHRASE_KEYS_META_VERSIONS = frozenset(
     {PASSPHRASE_KEYS_META_VERSION_1}
 )
 
+# OpenPGP key-cryptor remote-meta format: the Keys blob is an OpenPGP
+# message encrypted to the recipient keyring (the interop the reference's
+# gpgme backend declared and never shipped)
+GPG_KEYS_META_VERSION_1 = uuid.UUID(
+    "7b0e66a1-9c2d-4f5e-b6a7-3d8c1e4f5a62"
+).bytes
+SUPPORTED_GPG_KEYS_META_VERSIONS = frozenset({GPG_KEYS_META_VERSION_1})
+
 # Recipient-keyed (X25519) key-cryptor remote-meta format: the Keys blob
 # sealed to a set of recipient public keys (ephemeral ECDH + HKDF + AEAD).
 X25519_KEYS_META_VERSION_1 = uuid.UUID(
