@@ -151,6 +151,31 @@ class OpenOptions:
     accelerator: object = field(default_factory=HostAccelerator)
 
 
+async def open_sealed_blob(
+    keys: Keys, cryptor: Cryptor, raw: bytes, supported_data_versions=None
+):
+    """Unwrap one three-layer sealed blob (the single implementation of
+    the wire contract — the core and the fsck tool both go through here,
+    so the two can never drift).  ``supported_data_versions=None`` skips
+    the inner app-version check (diagnostic callers that do not know the
+    application's version set)."""
+    outer = VersionBytes.deserialize(raw).ensure_versions(
+        SUPPORTED_CONTAINER_VERSIONS
+    )
+    key_id, middle = codec.unpack(outer.content)
+    key = keys.get_key(bytes(key_id))
+    if key is None:
+        raise MissingKeyError(
+            f"blob sealed with unknown key {uuid.UUID(bytes=bytes(key_id))}; "
+            "key metadata may not have synced yet"
+        )
+    clear = await cryptor.decrypt(key.material, bytes(middle))
+    inner = VersionBytes.deserialize(clear)
+    if supported_data_versions is not None:
+        inner.ensure_versions(supported_data_versions)
+    return codec.unpack(inner.content)
+
+
 class _MutData:
     """All mutable core state.  LockBox discipline: methods touching this
     must be synchronous (asyncio makes sync sections atomic); the only
@@ -301,21 +326,9 @@ class Core:
         ).serialize()
 
     async def _open_sealed(self, raw: bytes):
-        outer = VersionBytes.deserialize(raw).ensure_versions(
-            SUPPORTED_CONTAINER_VERSIONS
+        return await open_sealed_blob(
+            self._data.keys, self.cryptor, raw, self.supported_data_versions
         )
-        key_id, middle = codec.unpack(outer.content)
-        key = self._data.keys.get_key(bytes(key_id))
-        if key is None:
-            raise MissingKeyError(
-                f"blob sealed with unknown key {uuid.UUID(bytes=bytes(key_id))}; "
-                "key metadata may not have synced yet"
-            )
-        clear = await self.cryptor.decrypt(key.material, bytes(middle))
-        inner = VersionBytes.deserialize(clear).ensure_versions(
-            self.supported_data_versions
-        )
-        return codec.unpack(inner.content)
 
     # ------------------------------------------------------------- apply_ops
     async def apply_ops(self, ops: list) -> None:

@@ -1,0 +1,277 @@
+"""Remote integrity checker — failure detection for the synced directory.
+
+The replication substrate is a passively synced directory written by many
+replicas (reference README.md:3-11); the failure modes that matter are
+sync-tool damage and bit rot: torn/truncated blobs, tampered ciphertext,
+content-addressed files whose name no longer matches their bytes, op-log
+gaps that stall every consumer's dense scan, and key metadata that no
+longer decodes.  The crash-safety ORDERING is by construction
+(write-new-before-delete-old); this tool detects what ordering cannot
+prevent.
+
+``fsck_remote`` walks one remote through the SAME plugin stack a replica
+uses (storage + cryptor + key cryptor), verifies every object family, and
+returns a structured report; the CLI prints it.  Read-only — never
+repairs, because the right repair is re-sync or restore of immutable
+content-addressed files.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..backends.memory import content_name
+from ..core.core import RemoteMeta
+from ..core.key_cryptor import Keys
+from ..utils import VersionBytes, codec
+from ..utils.versions import SUPPORTED_CONTAINER_VERSIONS
+
+
+@dataclass
+class Issue:
+    severity: str  # "error" | "warn"
+    family: str  # "meta" | "states" | "ops" | "keys"
+    obj: str  # file name / actor:version
+    problem: str
+
+    def __str__(self) -> str:
+        return f"[{self.severity}] {self.family} {self.obj}: {self.problem}"
+
+
+@dataclass
+class FsckReport:
+    meta_files: int = 0
+    state_files: int = 0
+    op_files: int = 0
+    op_actors: int = 0
+    ops_decoded: int = 0
+    keys_found: int = 0
+    issues: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not any(i.severity == "error" for i in self.issues)
+
+    def add(self, severity, family, obj, problem):
+        self.issues.append(Issue(severity, family, obj, problem))
+
+    def summary(self) -> str:
+        errors = sum(1 for i in self.issues if i.severity == "error")
+        warns = len(self.issues) - errors
+        return (
+            f"{'OK' if self.ok else 'DAMAGED'}: {self.meta_files} meta, "
+            f"{self.state_files} states, {self.op_files} op files across "
+            f"{self.op_actors} actors ({self.ops_decoded} ops), "
+            f"{self.keys_found} data keys; {errors} error(s), {warns} warning(s)"
+        )
+
+
+async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> FsckReport:
+    """Verify one remote.  ``deep=True`` additionally decrypts every state
+    and op file (auth check) and parses the cleartext framing; ``False``
+    checks structure and names only.
+
+    The key cryptor receives the remote's converged key register exactly
+    as a replica would (``set_remote_meta``); decrypting then uses a core
+    stub that only collects keys — no replica state is created anywhere.
+    """
+    report = FsckReport()
+
+    class _Collector:
+        """Just enough CoreSubHandle surface for a key cryptor."""
+
+        keys = Keys()
+        actor_id = b"\x00" * 16
+
+        def set_keys(self, keys):
+            self.keys = keys
+
+        async def set_remote_meta_key_cryptor(self, reg):
+            pass  # read-only: never write the remote
+
+    collector = _Collector()
+    await key_cryptor.init(collector)
+
+    # ---- meta family -----------------------------------------------------
+    meta = RemoteMeta()
+    names = await storage.list_remote_meta_names()
+    loaded = dict(await storage.load_remote_metas(names))
+    for name in names:
+        raw = loaded.get(name)
+        if raw is None:
+            report.add("warn", "meta", name, "listed but unreadable (racing GC?)")
+            continue
+        report.meta_files += 1
+        if content_name(raw) != name:
+            report.add("error", "meta", name, "content does not match its address")
+            continue
+        try:
+            vb = VersionBytes.deserialize(raw).ensure_versions(
+                SUPPORTED_CONTAINER_VERSIONS
+            )
+            meta.merge(RemoteMeta.from_obj(codec.unpack(vb.content)))
+        except Exception as e:
+            report.add("error", "meta", name, f"malformed: {e}")
+    try:
+        await key_cryptor.set_remote_meta(meta.key_cryptor)
+    except Exception as e:
+        report.add("error", "keys", "register", f"key metadata does not decode: {e}")
+    keys = collector.keys
+    report.keys_found = len(keys.keys.entries)
+    latest_ok = False
+    try:
+        latest_ok = keys.latest_key() is not None
+    except Exception as e:  # e.g. DanglingLatestKey: id survives, material lost
+        report.add("error", "keys", "latest", f"latest key unresolvable: {e}")
+
+    from ..core.core import open_sealed_blob
+
+    async def open_sealed(raw: bytes):
+        # the shared wire-contract implementation (core.open_sealed_blob);
+        # the app's inner data-version set is unknown here, so that one
+        # check is skipped
+        clear_obj = await open_sealed_blob(keys, cryptor, raw)
+        return clear_obj
+
+    # ---- states ----------------------------------------------------------
+    names = await storage.list_state_names()
+    loaded = dict(await storage.load_states(names))
+    for name in names:
+        raw = loaded.get(name)
+        if raw is None:
+            report.add("warn", "states", name, "listed but unreadable (racing GC?)")
+            continue
+        report.state_files += 1
+        if content_name(raw) != name:
+            report.add("error", "states", name, "content does not match its address")
+            continue
+        if not deep:
+            continue
+        try:
+            obj = await open_sealed(raw)
+            if not (isinstance(obj, (list, tuple)) and len(obj) == 2):
+                raise ValueError("state wrapper is not [state, cursor]")
+        except Exception as e:
+            report.add("error", "states", name, f"{e}")
+
+    # ---- op logs ---------------------------------------------------------
+    actors = await storage.list_op_actors()
+    report.op_actors = len(actors)
+    for actor in actors:
+        hexa = actor.hex()
+        versions = await _list_op_versions(storage, actor)
+        if versions is None:
+            report.add(
+                "warn", "ops", hexa,
+                "storage backend cannot enumerate op versions; "
+                "gap detection skipped",
+            )
+            if deep:
+                files = await storage.load_ops([(actor, 1)])
+                report.op_files += len(files)
+                await _deep_check_ops(report, open_sealed, hexa, files)
+            continue
+        report.op_files += len(versions)
+        if not versions:
+            continue
+        # dense from the FLOOR — compaction legitimately GCs a prefix, so
+        # a log starting at N+1 is healthy; only holes with files beyond
+        # them strand data (every consumer's scan stops at the hole)
+        floor = versions[0]
+        expected = set(range(floor, floor + len(versions)))
+        missing = sorted(expected - set(versions))
+        if missing:
+            report.add(
+                "error", "ops", hexa,
+                f"gap at version {missing[0]}: "
+                f"{sum(1 for v in versions if v > missing[0])} file(s) "
+                "beyond it are unreachable by the dense scan",
+            )
+        if deep:
+            files = await storage.load_ops([(actor, floor)])
+            await _deep_check_ops(report, open_sealed, hexa, files)
+    if not latest_ok and (
+        report.meta_files or report.keys_found
+        or report.state_files or report.op_files
+    ):
+        report.add(
+            "error", "keys", "latest",
+            "no resolvable latest data key (key metadata lost?)",
+        )
+    return report
+
+
+async def _deep_check_ops(report, open_sealed, hexa: str, files: list) -> None:
+    for _, version, raw in files:
+        try:
+            ops = await open_sealed(raw)
+            if not isinstance(ops, (list, tuple)):
+                raise ValueError("op payload is not an array")
+            report.ops_decoded += len(ops)
+        except Exception as e:
+            report.add("error", "ops", f"{hexa}:{version}", f"{e}")
+
+
+async def _list_op_versions(storage, actor) -> list[int] | None:
+    """Sorted op-file versions for one actor WITHOUT reading file bytes,
+    or None when the backend cannot enumerate them (no fs directory and
+    no in-memory table)."""
+    ops_dir = getattr(storage, "_ops_dir", None)
+    if ops_dir is not None:
+        import os
+
+        try:
+            names = os.listdir(ops_dir(actor))
+        except FileNotFoundError:
+            return []
+        return sorted(int(n) for n in names if n.isdigit())
+    table = getattr(storage, "remote", None)
+    ops = getattr(table, "ops", None)
+    if isinstance(ops, dict):  # MemoryRemote: {actor: {version: bytes}}
+        return sorted(int(v) for v in ops.get(actor, {}))
+    return None
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m crdt_enc_tpu.tools.fsck REMOTE [--shallow]
+    [--passphrase …]`` — checks a remote written with the XChaCha cryptor
+    and the plain (or passphrase) key cryptor."""
+    import argparse
+    import asyncio
+    import tempfile
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("remote", help="remote directory to verify (read-only)")
+    ap.add_argument("--shallow", action="store_true",
+                    help="skip decrypt/auth; structure and names only")
+    ap.add_argument("--passphrase", help="passphrase-sealed key metadata")
+    args = ap.parse_args(argv)
+
+    from ..backends import (
+        FsStorage,
+        PassphraseKeyCryptor,
+        PlainKeyCryptor,
+        XChaChaCryptor,
+    )
+
+    async def go():
+        with tempfile.TemporaryDirectory() as scratch:
+            storage = FsStorage(scratch, args.remote)
+            kc = (
+                PassphraseKeyCryptor(args.passphrase)
+                if args.passphrase
+                else PlainKeyCryptor()
+            )
+            report = await fsck_remote(
+                storage, XChaChaCryptor(), kc, deep=not args.shallow
+            )
+        for issue in report.issues:
+            print(issue)
+        print(report.summary())
+        return 0 if report.ok else 1
+
+    return asyncio.run(go())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -111,3 +111,17 @@ def test_random_schedule_converges(tmp_path, seed):
         assert fresh.with_state(canonical_bytes) == blobs[0]
 
     run(go())
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_random_schedule_converges_chunked_sessions(tmp_path, seed, monkeypatch):
+    """The same convergence property with the ingest pipeline maximally
+    stressed: tiny fs chunks and instant session promotion, so every
+    accelerated sync runs multi-chunk host-reduce fold sessions instead
+    of single-batch folds."""
+    import crdt_enc_tpu.parallel.session as S
+    from crdt_enc_tpu.backends.fs import FsStorage
+
+    monkeypatch.setattr(S, "BUFFER_BYTES", 64)
+    monkeypatch.setattr(FsStorage, "CHUNK_BYTES", 2048)
+    test_random_schedule_converges(tmp_path, seed)
