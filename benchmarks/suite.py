@@ -83,17 +83,28 @@ def timeit_marginal(make_chained, iters: int, chain: int) -> tuple[float, str]:
     from bench import TUNNEL_JITTER_S
 
     t1 = timeit(make_chained(1), iters)
-    tk = timeit(make_chained(1 + chain), iters)
-    marginal = (tk - t1) / chain
-    floor = TUNNEL_JITTER_S / chain
-    if marginal <= floor:
+    # escalate the chain until the marginal signal clears the jitter floor
+    # (folds keep getting faster; a fixed chain length goes deaf), bounded
+    # so a pathological near-zero marginal can't spin forever
+    max_chain = max(chain * 100, 1_000_000)
+    while True:
+        tk = timeit(make_chained(1 + chain), iters)
+        marginal = (tk - t1) / chain
+        floor = TUNNEL_JITTER_S / chain
+        if marginal > floor:
+            return marginal, "marginal_chain"
+        if chain * 10 > max_chain:
+            log(
+                f"  marginal {marginal * 1e3:.3f}ms/fold below noise floor "
+                f"{floor * 1e3:.3f}ms at chain={chain}; using single-dispatch "
+                f"{t1 * 1e3:.1f}ms (tunnel latency included)"
+            )
+            return t1, "single_dispatch_upper_bound"
         log(
-            f"  marginal {marginal * 1e3:.3f}ms/fold below noise floor "
-            f"{floor * 1e3:.3f}ms; using single-dispatch {t1 * 1e3:.1f}ms "
-            "(tunnel latency included)"
+            f"  chain={chain} below noise floor "
+            f"({marginal * 1e3:.4f}ms ≤ {floor * 1e3:.4f}ms); escalating"
         )
-        return t1, "single_dispatch_upper_bound"
-    return marginal, "marginal_chain"
+        chain *= 10
 
 
 def actor_bytes_table(R: int) -> list:
@@ -107,6 +118,7 @@ def actor_bytes_table(R: int) -> list:
 def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     """Config 1: G-Counter, 4 replicas, 1k increment ops."""
     import jax
+    import jax.numpy as jnp
 
     from crdt_enc_tpu import ops as K
     from crdt_enc_tpu.models import GCounter
@@ -130,7 +142,13 @@ def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
         @jax.jit
         def run(clock0, actor, counter):
             def body(carry, _):
-                clock, total = K.gcounter_fold(carry, actor, counter, num_replicas=R)
+                # anchor the batch to the carry: min(clock[0], 0) is 0 at
+                # runtime (counters are ≥ 0) but XLA cannot prove it, so
+                # the scatter cannot be hoisted out of the loop — without
+                # this the chain times only the elementwise tail
+                # (measured: marginal flat in N, >HBM-peak "rates")
+                c2 = counter + jnp.minimum(carry[0], 0)
+                clock, total = K.gcounter_fold(carry, actor, c2, num_replicas=R)
                 return clock, total
             return jax.lax.scan(body, clock0, None, length=n)
         return lambda: run(*dev_args)
@@ -150,6 +168,7 @@ def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
 def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     """Config 2: PN-Counter, 1k replicas, 100k mixed inc/dec ops."""
     import jax
+    import jax.numpy as jnp
 
     from crdt_enc_tpu import ops as K
     from crdt_enc_tpu.models import PNCounter
@@ -179,8 +198,11 @@ def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
         @jax.jit
         def run(p0, n0, sign, actor, counter):
             def body(carry, _):
+                # carry-anchor the batch so the segment-max cannot be
+                # hoisted out of the loop (see bench_gcounter)
+                c2 = counter + jnp.minimum(carry[0][0], 0)
                 p, nn, value = K.pncounter_fold(
-                    *carry, sign, actor, counter, num_replicas=R
+                    *carry, sign, actor, c2, num_replicas=R
                 )
                 return (p, nn), value
             return jax.lax.scan(body, (p0, n0), None, length=n)
@@ -312,9 +334,17 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
             )
 
             def body(carry, _):
+                # rotate the batch by a carry-derived offset: the fold is
+                # order-independent so the result is identical, but the
+                # inputs are loop-varying as far as XLA can tell, so the
+                # scatter passes cannot be hoisted out of the loop
+                # (measured un-anchored: marginal shrinks as N grows —
+                # the chain was timing only the elementwise compete)
+                off = jnp.abs(carry[0][0]) % jnp.int32(len(key))
+                rolled = [jnp.roll(x, off) for x in (key, hi, lo, actor, value)]
                 return (
                     K.lww_fold_into(
-                        carry, key, hi, lo, actor, value,
+                        carry, *rolled,
                         num_keys=K_keys, num_values=n_values,
                     ),
                     (),
@@ -454,10 +484,16 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
         t_host = min(t_host, time.perf_counter() - t0)
     host_rate = n_ops / t_host
 
-    # ---- streaming pipeline: the PRODUCT bulk path — threaded batch
-    # decrypt → accelerator fold_payloads (native columnar decode + device
-    # fold, sparse-COO routed at this replica scale).  Headers decoded
-    # host-side, they are tiny.
+    # ---- streaming pipeline: chunked threaded batch decrypt overlapping
+    # the native columnar decode (fold_payload_stream), then one sparse
+    # fold at this replica scale.  This is the same machinery the product
+    # ingest runs: Core's bulk path feeds open_payload_stream under a
+    # decrypt lookahead (core.py _read_remote_ops_bulk), and the pipelined
+    # session's BUFFER mode finishes through the identical
+    # _fold_orset_columns tail; the full product path on a real remote is
+    # measured separately in benchmarks/compaction_e2e.py.  Headers
+    # decoded host-side, they are tiny.
+    from crdt_enc_tpu.backends.xchacha import decrypt_blobs_chunked
     from crdt_enc_tpu.parallel import TpuAccelerator
 
     accel = TpuAccelerator()
@@ -465,10 +501,10 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
 
     def pipeline():
         folded = ORSet()
-        clears = decrypt_blobs(key, payloads)
+        chunks = decrypt_blobs_chunked(key, payloads, n_chunks=8)
         for h in decrypt_blobs(key, headers):
             MVReg.from_obj(codec.unpack(h))
-        ok = accel.fold_payloads(folded, clears, actors_hint=actors_sorted)
+        ok = accel.fold_payload_stream(folded, chunks, actors_hint=actors_sorted)
         assert ok, "accelerator declined the bulk payload batch"
         return folded
 

@@ -45,6 +45,7 @@ from .storage import Storage
 
 IO_CONCURRENCY = 16  # bounded pipeline width (reference lib.rs:452,512)
 BULK_MIN_FILES = 16  # below this the per-file asyncio path is cheaper
+BULK_STREAM_CHUNK = 16384  # files per decrypt-lookahead chunk (bulk ingest)
 
 
 class CoreError(Exception):
@@ -745,54 +746,117 @@ class Core:
         groups: dict[bytes, list[int]] = {}
         for i, kid in enumerate(key_ids):
             groups.setdefault(kid, []).append(i)
-        clears: list = [None] * len(files)
-        with trace.span("ops.bulk_decrypt"):
-            for kid, idxs in groups.items():
-                key = self._data.keys.get_key(kid)
-                if key is None:
-                    raise MissingKeyError(
-                        f"ops sealed with unknown key {uuid.UUID(bytes=kid)}; "
-                        "key metadata may not have synced yet"
-                    )
-                outs = await self.cryptor.decrypt_batch(
-                    key.material, [middles[i] for i in idxs]
+        keys = {}
+        for kid in groups:
+            key = self._data.keys.get_key(kid)
+            if key is None:
+                raise MissingKeyError(
+                    f"ops sealed with unknown key {uuid.UUID(bytes=kid)}; "
+                    "key metadata may not have synced yet"
                 )
-                for i, clear in zip(idxs, outs):
-                    clears[i] = clear
+            keys[kid] = key
+
+        # Single sealing key (the overwhelmingly common case) + a stream-
+        # capable accelerator: chunked decrypt with one-chunk lookahead —
+        # the worker thread decrypts chunk i+1 (native, GIL released)
+        # while this thread validates and span-decodes chunk i; one
+        # combined fold at the end.  The same pipeline benchmarks/suite.py
+        # config 5 measures.
+        open_stream = getattr(self.accel, "open_payload_stream", None)
+        stream = (
+            open_stream(self._data.state, actors_hint=actors)
+            if open_stream is not None and len(groups) == 1
+            else None
+        )
+        payload_chunks: list[list] = []
+        metas: list = []
+        overlay: dict[Actor, int] = {}
+        streamed_ok = stream is not None
+        with trace.span("ops.bulk_decrypt"):
+            if stream is not None:
+                (kid, idxs), = groups.items()
+                material = keys[kid].material
+                CH = BULK_STREAM_CHUNK
+                slices = [idxs[i : i + CH] for i in range(0, len(idxs), CH)]
+                nxt = asyncio.create_task(
+                    self.cryptor.decrypt_batch(
+                        material, [middles[i] for i in slices[0]]
+                    )
+                )
+                try:
+                    for si, sl in enumerate(slices):
+                        clears = await nxt
+                        nxt = (
+                            asyncio.create_task(
+                                self.cryptor.decrypt_batch(
+                                    material,
+                                    [middles[i] for i in slices[si + 1]],
+                                )
+                            )
+                            if si + 1 < len(slices)
+                            else None
+                        )
+                        if nxt is not None:
+                            # a created task has not executed yet: one tick
+                            # steps it into its to_thread so the worker
+                            # decrypts WHILE this thread validates+decodes
+                            # (without this the "lookahead" is serialized)
+                            await asyncio.sleep(0)
+                        # sync: inner version checks WITHOUT cursor advance
+                        # — cursors move only after the fold lands (same
+                        # discipline as the pipelined path; an OpOrderError
+                        # mid-batch must not strand validated-but-unfolded
+                        # ops behind advanced cursors)
+                        p, m = self._validate_chunk(
+                            [files[i] for i in sl], clears, overlay
+                        )
+                        metas.extend(m)
+                        payload_chunks.append(p)
+                        if streamed_ok:
+                            streamed_ok = stream.feed(p)
+                finally:
+                    if nxt is not None:
+                        nxt.cancel()
+                        try:
+                            await nxt
+                        except (asyncio.CancelledError, Exception):
+                            pass
+            else:
+                clears: list = [None] * len(files)
+                for kid, idxs in groups.items():
+                    outs = await self.cryptor.decrypt_batch(
+                        keys[kid].material, [middles[i] for i in idxs]
+                    )
+                    for i, clear in zip(idxs, outs):
+                        clears[i] = clear
+                p, m = self._validate_chunk(files, clears, overlay)
+                metas.extend(m)
+                payload_chunks.append(p)
         trace.add("bytes_decrypted", sum(len(m) for m in middles))
 
-        # sync section: inner version checks + ordered bookkeeping + fold
-        payloads = []
-        for (actor, version, _), clear in zip(files, clears):
-            expected = self._data.next_op_versions.get(actor) + 1
-            if version < expected:
-                continue  # concurrent-read tolerance (lib.rs:521-525)
-            if version > expected:
-                raise OpOrderError(
-                    f"op file v{version} for {uuid.UUID(bytes=actor)} arrived "
-                    f"beyond expected v{expected}"
-                )
-            inner = VersionBytes.deserialize(clear).ensure_versions(
-                self.supported_data_versions
-            )
-            payloads.append(inner.content)
-            self._data.next_op_versions.apply(Dot(actor, version))
+        payloads = [p for chunk in payload_chunks for p in chunk]
         if not payloads:
             return True
         with trace.span("ops.bulk_fold"):
-            if self.accel.fold_payloads(
-                self._data.state, payloads, actors_hint=actors
-            ):
+            if streamed_ok and stream.finish():
+                self._advance_cursors(metas)
                 trace.add("op_files_bulk_folded", len(payloads))
                 return True
-            # accelerator declined (non-columnar CRDT): decode per-op in
-            # Python but still fold as one batch
+            if stream is None and self.accel.fold_payloads(
+                self._data.state, payloads, actors_hint=actors
+            ):
+                self._advance_cursors(metas)
+                trace.add("op_files_bulk_folded", len(payloads))
+                return True
+            # accelerator declined (non-columnar CRDT, vocab collision):
+            # decode per-op in Python but still fold as one batch
             batch = []
             for p in payloads:
                 batch.extend(
                     self.adapter.op_from_obj(o) for o in codec.unpack(p)
                 )
             self.accel.fold_ops(self._data.state, batch)
+            self._advance_cursors(metas)
             trace.add("ops_folded", len(batch))
         return True
 

@@ -52,10 +52,16 @@ class Vocab:
     """Interning table: object → dense index (first-appearance order)."""
 
     def __init__(self, items=()):
-        self.index: dict = {}
-        self.items: list = []
-        for it in items:
-            self.intern(it)
+        items = list(items)
+        index = dict(zip(items, range(len(items))))
+        if len(index) == len(items):  # no duplicates: one bulk dict build
+            self.index: dict = index
+            self.items: list = items
+        else:
+            self.index = {}
+            self.items = []
+            for it in items:
+                self.intern(it)
 
     def intern(self, item) -> int:
         idx = self.index.get(item)
@@ -263,41 +269,92 @@ def orset_apply_coo(
     k = np.asarray(seg_keys)[sel].astype(np.int64)
     c = np.asarray(seg_max)[sel]
     mobj = members.items
-    aobj = replicas.items
+    aobj_arr = np.asarray(replicas.items, dtype=object)
 
     # keys are sorted: adds (key < E·R) form the prefix, removes the
     # suffix, and within each side rows are member-major — so members are
     # contiguous groups and fresh entries build as one dict(zip(...))
     split = int(np.searchsorted(k, E * R))
+    ak, ac = k[:split], c[:split]
+    rk, rc = k[split:] - E * R, c[split:]
+    a_m, a_a = ak // R, ak % R
+    r_m, r_a = rk // R, rk % R
+
+    # Members absent from BOTH state.entries and state.deferred take a
+    # fully vectorized path: for them the post-merge dicts are exactly the
+    # batch segments with the normalization rules applied column-wise —
+    # adds killed where ≤ the batch horizon on the same (member, actor)
+    # segment, horizons dropped where ≤ the merged clock — so no per-member
+    # Python normalize is needed.  On a fresh ingest that is every member.
+    clock_arr = np.asarray(clock_dense, np.int64)
+    if not state.entries and not state.deferred:
+        fresh = None  # all members fresh
+        a_fresh = np.ones(len(ak), bool)
+        r_fresh = np.ones(len(rk), bool)
+        pre_deferred: list = []
+    else:
+        existing = set(state.entries)
+        existing.update(state.deferred)
+        # pre-existing horizons re-normalize below even when the batch
+        # never mentions them: the batch may have advanced clocks that
+        # retire them
+        pre_deferred = list(state.deferred)
+        fresh = np.fromiter(
+            (mo not in existing for mo in mobj), bool, count=E
+        )
+        a_fresh = fresh[a_m]
+        r_fresh = fresh[r_m]
+
+    def build_fresh(m_idx, a_idx, vals, target: dict):
+        if not len(m_idx):
+            return
+        starts = np.flatnonzero(np.r_[True, np.diff(m_idx) != 0])
+        ends = np.r_[starts[1:], len(m_idx)]
+        a_objs = aobj_arr[a_idx].tolist()
+        vv = vals.tolist()
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            target[mobj[int(m_idx[s])]] = dict(zip(a_objs[s:e], vv[s:e]))
+
+    # fresh adds: survive the batch horizon for their own (m, a) segment
+    # (strict >: an equal horizon observed the dot — it dies)
+    if len(rk):
+        pos = np.minimum(np.searchsorted(rk, ak), len(rk) - 1)
+        horizon = np.where(rk[pos] == ak, rc[pos], 0)
+        keep_add = a_fresh & (ac > horizon)
+    else:
+        keep_add = a_fresh
+    build_fresh(a_m[keep_add], a_a[keep_add], ac[keep_add], state.entries)
+    # fresh horizons: only those the merged clock has not caught up with
+    keep_rm = r_fresh & (rc > clock_arr[r_a])
+    build_fresh(r_m[keep_rm], r_a[keep_rm], rc[keep_rm], state.deferred)
+
+    # members with pre-existing state merge by max, then normalize
     touched: set = set()
 
-    def fold_groups(seg, vals, target: dict):
-        m_idx = seg // R
-        a_idx = (seg % R).tolist()
+    def fold_groups(m_idx, a_idx, vals, target: dict):
+        a_idx = a_idx.tolist()
         vals = vals.tolist()
         starts = np.flatnonzero(np.r_[True, np.diff(m_idx) != 0])
         ends = np.r_[starts[1:], len(m_idx)]
         for s, e in zip(starts.tolist(), ends.tolist()):
             mo = mobj[int(m_idx[s])]
             touched.add(mo)
-            slot = target.get(mo)
-            if slot is None:
-                target[mo] = dict(
-                    zip((aobj[x] for x in a_idx[s:e]), vals[s:e])
-                )
-            else:
-                for x, cc in zip(a_idx[s:e], vals[s:e]):
-                    ao = aobj[x]
-                    if cc > slot.get(ao, 0):
-                        slot[ao] = cc
+            slot = target.setdefault(mo, {})
+            for x, cc in zip(a_idx[s:e], vals[s:e]):
+                ao = aobj_arr[x]
+                if cc > slot.get(ao, 0):
+                    slot[ao] = cc
 
-    if split:
-        fold_groups(k[:split], c[:split], state.entries)
-    if split < len(k):
-        fold_groups(k[split:] - E * R, c[split:], state.deferred)
+    if fresh is not None:
+        stale_a = ~a_fresh
+        if stale_a.any():
+            fold_groups(a_m[stale_a], a_a[stale_a], ac[stale_a], state.entries)
+        stale_r = ~r_fresh
+        if stale_r.any():
+            fold_groups(r_m[stale_r], r_a[stale_r], rc[stale_r], state.deferred)
 
     state.clock = dense_to_vclock(clock_dense, replicas)
-    touched.update(state.deferred)
+    touched.update(pre_deferred)
     for mo in touched:
         state._normalize_member(mo)
     return state
@@ -349,7 +406,9 @@ def vclock_to_dense(clock: VClock, replicas: Vocab) -> np.ndarray:
 
 def dense_to_vclock(arr: np.ndarray, replicas: Vocab) -> VClock:
     arr = np.asarray(arr)
-    return VClock({replicas.items[i]: int(arr[i]) for i in np.nonzero(arr)[0]})
+    nz = np.nonzero(arr)[0]
+    robj = np.asarray(replicas.items, dtype=object)[nz].tolist()
+    return VClock(dict(zip(robj, arr[nz].tolist())))
 
 
 # ---- LWW -----------------------------------------------------------------

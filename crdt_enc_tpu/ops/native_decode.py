@@ -32,14 +32,29 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     int arrays over all payloads' rows plus the interned member-object
     list (first-appearance order) — or None to request Python fallback.
     """
+    part = decode_orset_payload_spans(payloads, actors_sorted)
+    if part is None:
+        return None
+    return combine_orset_spans([part])
+
+
+def decode_orset_payload_spans(payloads: list, actors_sorted: list):
+    """Native two-pass decode of one payload chunk to raw span columns.
+
+    Returns ``(buf, kind, moff, mlen, actor, counter)`` — member values
+    stay as (offset, length) spans into ``buf`` so chunks decoded at
+    different times can be combined and interned once
+    (``combine_orset_spans``) — or None to request Python fallback.
+    """
     lib = native.load()
     if not payloads:
         return (
+            np.zeros(0, np.uint8),
             np.zeros(0, np.int8),
+            np.zeros(0, np.uint64),
+            np.zeros(0, np.uint64),
             np.zeros(0, np.int32),
             np.zeros(0, np.int32),
-            np.zeros(0, np.int32),
-            [],
         )
     big = b"".join(payloads)
     buf = np.frombuffer(big, np.uint8)
@@ -60,20 +75,14 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     )
     if total < 0:
         return None
-    if total == 0:
-        return (
-            np.zeros(0, np.int8),
-            np.zeros(0, np.int32),
-            np.zeros(0, np.int32),
-            np.zeros(0, np.int32),
-            [],
-        )
 
     kind = np.zeros(total, np.int8)
     moff = np.zeros(total, np.uint64)
     mlen = np.zeros(total, np.uint64)
     actor = np.zeros(total, np.int32)
     counter = np.zeros(total, np.int32)
+    if total == 0:
+        return buf, kind, moff, mlen, actor, counter
 
     # pass 2: decode everything into consecutive row slices — one call
     got = lib.orset_decode_batch(
@@ -87,7 +96,27 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     )
     if got != total:
         return None
+    return buf, kind, moff, mlen, actor, counter
 
+
+def combine_orset_spans(parts: list):
+    """Concatenate span chunks from ``decode_orset_payload_spans`` and
+    intern the member spans once.  Returns the same tuple as
+    ``decode_orset_payload_batch``."""
+    if len(parts) == 1:
+        buf, kind, moff, mlen, actor, counter = parts[0]
+    else:
+        bufs = [p[0] for p in parts]
+        base = np.zeros(len(bufs), np.uint64)
+        np.cumsum([len(b) for b in bufs[:-1]], out=base[1:])
+        buf = np.concatenate(bufs) if bufs else np.zeros(0, np.uint8)
+        kind = np.concatenate([p[1] for p in parts])
+        moff = np.concatenate([p[2] + b for p, b in zip(parts, base)])
+        mlen = np.concatenate([p[3] for p in parts])
+        actor = np.concatenate([p[4] for p in parts])
+        counter = np.concatenate([p[5] for p in parts])
+    if len(kind) == 0:
+        return kind, np.zeros(0, np.int32), actor, counter, []
     member_idx, members = intern_spans(buf, moff, mlen)
     return kind, member_idx, actor, counter, members
 

@@ -272,17 +272,58 @@ class TpuAccelerator(HostAccelerator):
             return False
         from ..ops.native_decode import decode_orset_payload_batch
 
+        actors_sorted = self._orset_actor_table(state, actors_hint)
+        with trace.span("fold.decode"):
+            decoded = decode_orset_payload_batch(payloads, actors_sorted)
+        if decoded is None:
+            return False
+        return self._fold_orset_decoded(state, decoded, actors_sorted)
+
+    def fold_payload_stream(self, state, chunks, actors_hint=()) -> bool:
+        """ORSet bulk front end over an *iterator* of decrypted-payload
+        chunks (e.g. ``xchacha.decrypt_blobs_chunked``): each chunk
+        decodes while the producer decrypts the next, then all rows fold
+        once.  On False the stream is closed (a generator's pending
+        lookahead is cancelled at its next yield) and the caller replays
+        its own copy of the payloads down the per-op path."""
+        stream = self.open_payload_stream(state, actors_hint=actors_hint)
+        if stream is None:
+            return False
+        try:
+            for chunk in chunks:
+                if not stream.feed(chunk):
+                    return False
+        finally:
+            close = getattr(chunks, "close", None)
+            if close is not None:
+                close()
+        return stream.finish()
+
+    def open_payload_stream(self, state, actors_hint=()):
+        """Incremental bulk front end: returns a stream with
+        ``feed(payloads) -> bool`` (decodes one chunk; False = declined,
+        nothing folded) and ``finish() -> bool`` (one combined fold into
+        ``state``), or None when ``state`` has no columnar bulk path.
+        ``feed`` only decodes — callers overlap it with their own decrypt
+        of the next chunk (the native calls release the GIL); ``state``
+        mutates only inside ``finish``.  Caller-serialized, like the fold
+        sessions (parallel/session.py)."""
+        if not isinstance(state, ORSet):
+            return None
+        return _OrsetPayloadStream(self, state, actors_hint)
+
+    def _orset_actor_table(self, state: ORSet, actors_hint) -> list:
+        """Sorted actor table for the native decoder (it binary-searches):
+        the caller's hint plus every actor the state mentions."""
         actor_set = set(actors_hint)
         actor_set.update(state.clock.counters)
         for entry in state.entries.values():
             actor_set.update(entry)
         for dfr in state.deferred.values():
             actor_set.update(dfr)
-        actors_sorted = sorted(actor_set)
-        with trace.span("fold.decode"):
-            decoded = decode_orset_payload_batch(payloads, actors_sorted)
-        if decoded is None:
-            return False
+        return sorted(actor_set)
+
+    def _fold_orset_decoded(self, state: ORSet, decoded, actors_sorted) -> bool:
         kind, member_idx, actor_idx, counter, member_objs = decoded
         if len(kind) == 0:
             return True
@@ -590,3 +631,56 @@ class TpuAccelerator(HostAccelerator):
         state.entries = merged.entries
         state.deferred = merged.deferred
         return state
+
+
+class _OrsetPayloadStream:
+    """Incremental ORSet bulk front end (``TpuAccelerator.open_payload_
+    stream``): per-chunk native span decode, one combined intern + fold at
+    ``finish``.  The product's bulk ingest feeds chunks as its decrypt
+    lookahead lands (core.py ``_read_remote_ops_bulk``); the state is
+    untouched until ``finish`` returns True, so a declined or abandoned
+    stream leaves the replica exactly as it was."""
+
+    def __init__(self, accel: TpuAccelerator, state: ORSet, actors_hint=()):
+        self.accel = accel
+        self.state = state
+        self.actors_sorted = accel._orset_actor_table(state, actors_hint)
+        self.parts: list = []
+        self.declined = False
+        self._finished = False
+
+    def feed(self, payloads: list) -> bool:
+        """Decode one chunk of decrypted payloads.  False = the native
+        decoder declined (unknown actor, non-canonical encoding); the
+        stream is dead and the caller replays through the per-op path."""
+        from ..ops.native_decode import decode_orset_payload_spans
+
+        assert not self._finished, "stream already finished"
+        if self.declined:
+            return False
+        if not payloads:
+            return True
+        with trace.span("fold.decode"):
+            part = decode_orset_payload_spans(payloads, self.actors_sorted)
+        if part is None:
+            self.declined = True
+            return False
+        self.parts.append(part)
+        return True
+
+    def finish(self) -> bool:
+        """Combine every fed chunk and fold into the state (the only
+        mutation).  False = vocab collision; state untouched."""
+        from ..ops.native_decode import combine_orset_spans
+
+        assert not self._finished, "stream already finished"
+        assert not self.declined, "stream was declined"
+        self._finished = True
+        if not self.parts:
+            return True
+        with trace.span("fold.decode"):
+            decoded = combine_orset_spans(self.parts)
+        self.parts = []
+        return self.accel._fold_orset_decoded(
+            self.state, decoded, self.actors_sorted
+        )

@@ -123,6 +123,7 @@ async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> Fs
         latest_ok = keys.latest_key() is not None
     except Exception as e:  # e.g. DanglingLatestKey: id survives, material lost
         report.add("error", "keys", "latest", f"latest key unresolvable: {e}")
+        latest_ok = True  # already reported — not also "no resolvable key"
 
     from ..core.core import open_sealed_blob
 
