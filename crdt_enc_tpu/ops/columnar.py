@@ -331,6 +331,8 @@ def orset_apply_coo(
     # members with pre-existing state merge by max, then normalize
     touched: set = set()
 
+    aobj = replicas.items
+
     def fold_groups(m_idx, a_idx, vals, target: dict):
         a_idx = a_idx.tolist()
         vals = vals.tolist()
@@ -341,7 +343,7 @@ def orset_apply_coo(
             touched.add(mo)
             slot = target.setdefault(mo, {})
             for x, cc in zip(a_idx[s:e], vals[s:e]):
-                ao = aobj_arr[x]
+                ao = aobj[x]
                 if cc > slot.get(ao, 0):
                     slot[ao] = cc
 
