@@ -14,17 +14,25 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from ..models import (
+    CrdtMap,
     EmptyCrdt,
     GCounter,
+    GSet,
     LWWMap,
     LWWOp,
+    LWWReg,
+    LWWRegOp,
+    MerkleNode,
+    MerkleReg,
     MVReg,
     MVRegOp,
     ORSet,
     PNCounter,
+    SeqList,
     VClock,
 )
 from ..models.orset import op_from_obj as orset_op_from_obj
+from ..models.seqlist import op_from_obj as seqlist_op_from_obj
 from ..models.vclock import Dot
 
 
@@ -104,6 +112,56 @@ def mvreg_adapter() -> CrdtAdapter:
         state_from_obj=MVReg.from_obj,
         op_to_obj=lambda op: [op.clock.to_obj(), op.value],
         op_from_obj=lambda obj: MVRegOp(VClock.from_obj(obj[0]), obj[1]),
+    )
+
+
+def gset_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"gset",
+        new=GSet,
+        state_from_obj=GSet.from_obj,
+        op_to_obj=lambda op: op,  # the op IS the member
+        op_from_obj=lambda obj: obj,
+    )
+
+
+def lwwreg_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"lwwreg",
+        new=LWWReg,
+        state_from_obj=LWWReg.from_obj,
+        op_from_obj=LWWRegOp.from_obj,
+    )
+
+
+def merklereg_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"merklereg",
+        new=MerkleReg,
+        state_from_obj=MerkleReg.from_obj,
+        op_from_obj=MerkleNode.from_obj,
+    )
+
+
+def list_adapter() -> CrdtAdapter:
+    return CrdtAdapter(
+        name=b"list",
+        new=SeqList,
+        state_from_obj=SeqList.from_obj,
+        op_from_obj=seqlist_op_from_obj,
+    )
+
+
+def map_adapter(child: bytes = b"orset") -> CrdtAdapter:
+    """Causal reset-remove map with nested CRDT values of type ``child``
+    (one of crdtmap.CHILD_TYPES)."""
+    proto = CrdtMap(child=child)  # op codec needs only the child type
+    return CrdtAdapter(
+        name=b"map+" + child,
+        new=lambda: CrdtMap(child=child),
+        state_from_obj=CrdtMap.from_obj,
+        op_to_obj=proto.op_to_obj,
+        op_from_obj=proto.op_from_obj,
     )
 
 

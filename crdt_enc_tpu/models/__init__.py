@@ -1,8 +1,13 @@
 from .base import Crdt, EmptyCrdt, canonical_bytes
 from .counters import GCounter, PNCounter, NEG, POS
+from .crdtmap import CrdtMap, RmOp as MapRmOp, UpOp as MapUpOp
+from .gset import GSet
 from .lwwmap import LWWMap, LWWOp
+from .lwwreg import LWWReg, LWWRegOp
+from .merkle_reg import MerkleNode, MerkleReg
 from .mvreg import MVReg, MVRegOp, ReadCtx
 from .orset import AddOp, ORSet, RmOp
+from .seqlist import DelOp, InsOp, SeqList
 from .vclock import Actor, Dot, VClock
 
 # Registry used by state decoders that need to resolve a CRDT type by name.
@@ -13,17 +18,32 @@ REGISTRY = {
     b"mvreg": MVReg,
     b"orset": ORSet,
     b"lwwmap": LWWMap,
+    b"gset": GSet,
+    b"lwwreg": LWWReg,
+    b"merklereg": MerkleReg,
+    b"list": SeqList,
+    b"map": CrdtMap,
 }
 
 __all__ = [
     "Actor",
     "AddOp",
     "Crdt",
+    "CrdtMap",
+    "DelOp",
     "Dot",
     "EmptyCrdt",
     "GCounter",
+    "GSet",
+    "InsOp",
     "LWWMap",
     "LWWOp",
+    "LWWReg",
+    "MapRmOp",
+    "MapUpOp",
+    "LWWRegOp",
+    "MerkleNode",
+    "MerkleReg",
     "MVReg",
     "MVRegOp",
     "NEG",
@@ -33,6 +53,7 @@ __all__ = [
     "ReadCtx",
     "REGISTRY",
     "RmOp",
+    "SeqList",
     "VClock",
     "canonical_bytes",
 ]

@@ -1,0 +1,429 @@
+"""Causal reset-remove map: keys to nested CRDT values.
+
+The external engine's ``map`` capability (the reference is generic over
+any ``crdts`` state type, lib.rs:189-197): a map whose values are
+themselves CRDTs, where removing a key deletes exactly the causal
+history the remover had *observed* — updates concurrent with the remove
+survive (observed-remove, the same add-wins discipline as the ORSet),
+and the nested value forgets only the removed context
+(``reset_remove``, implemented by every causal child type here).
+
+Dot discipline (mirrors the crate's ctx protocol): ONE dot per update
+authorizes both the map entry (the key's "birth" dots) and the child
+mutation — the child op builder receives that dot, so map-level replay
+protection and removal cover the child coherently.
+
+Structure parallels the tombstone-free ORSet (models/orset.py): per-key
+birth dots as dense per-actor maxima, deferred remove horizons for
+contexts beyond the local clock, one global clock.  The CvRDT merge uses
+the same clock-filter survivor rule; CmRDT/CvRDT agreement is pinned by
+the property tests against oracle-folded histories.
+
+Child types must provide ``apply``, ``merge``, ``reset_remove``,
+``to_obj``/``from_obj`` and an op decoder — see ``CHILD_TYPES``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils import codec
+from .counters import GCounter, PNCounter
+from .mvreg import MVReg, MVRegOp
+from .orset import ORSet
+from .orset import op_from_obj as orset_op_from_obj
+from .vclock import Actor, Dot, VClock
+
+
+def _pn_op_from_obj(obj):
+    return (int(obj[0]), Dot.from_obj(obj[1]))
+
+
+def _pn_op_to_obj(op):
+    return [op[0], op[1].to_obj()]
+
+
+# child registry: name -> (type, op_from_obj, op_to_obj)
+CHILD_TYPES = {
+    b"orset": (ORSet, orset_op_from_obj, lambda op: op.to_obj()),
+    b"mvreg": (
+        MVReg,
+        lambda obj: MVRegOp(VClock.from_obj(obj[0]), obj[1]),
+        lambda op: [op.clock.to_obj(), op.value],
+    ),
+    b"gcounter": (GCounter, Dot.from_obj, lambda op: op.to_obj()),
+    b"pncounter": (PNCounter, _pn_op_from_obj, _pn_op_to_obj),
+}
+
+
+@dataclass(frozen=True)
+class UpOp:
+    """One update: the dot births the key and authorizes ``child_op``."""
+
+    dot: Dot
+    key: object
+    child_op: object
+
+    def to_obj(self, child_op_to_obj):
+        return [0, self.dot.to_obj(), self.key, child_op_to_obj(self.child_op)]
+
+
+@dataclass(frozen=True)
+class RmOp:
+    """Observed-remove of ``keys`` under the read context ``ctx``."""
+
+    ctx: VClock
+    keys: tuple
+
+    def to_obj(self, _child_op_to_obj=None):
+        return [1, self.ctx.to_obj(), list(self.keys)]
+
+
+@dataclass
+class CrdtMap:
+    """``CrdtMap(child=b"orset")`` — the child type is fixed per map."""
+
+    child: bytes = b"orset"
+    clock: VClock = field(default_factory=VClock)
+    # key -> {actor: max birth counter}
+    births: dict = field(default_factory=dict)
+    # key -> child CRDT state
+    vals: dict = field(default_factory=dict)
+    # key -> {actor: remove horizon beyond the clock}
+    deferred: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.child not in CHILD_TYPES:
+            raise ValueError(f"unknown child CRDT type {self.child!r}")
+
+    def _child_type(self):
+        return CHILD_TYPES[self.child]
+
+    # -- op derivation -----------------------------------------------------
+    def update_ctx(self, actor: Actor, key, build_child_op) -> UpOp:
+        """Derive an update: ``build_child_op(child_state, dot)`` returns
+        the child op the shared dot authorizes (the child it receives is
+        the current value or a fresh empty one — never mutated here)."""
+        dot = self.clock.inc(actor)
+        cls = self._child_type()[0]
+        child = self.vals.get(key)
+        child = child if child is not None else cls()
+        return UpOp(dot, key, build_child_op(child, dot))
+
+    def rm_ctx(self, *keys) -> RmOp:
+        """Remove keys as observed: the context is the keys' birth dots
+        (everything this replica has seen of them)."""
+        ctx = VClock()
+        for key in keys:
+            for a, c in self.births.get(key, {}).items():
+                if c > ctx.get(a):
+                    ctx.counters[a] = c
+        return RmOp(ctx, tuple(keys))
+
+    # -- CmRDT -------------------------------------------------------------
+    def apply(self, op) -> None:
+        if isinstance(op, (list, tuple)):
+            op = self.op_from_obj(op)
+        if isinstance(op, UpOp):
+            self._apply_up(op)
+        elif isinstance(op, RmOp):
+            self._apply_rm(op)
+        else:
+            raise TypeError(f"bad CrdtMap op {op!r}")
+
+    def _apply_up(self, op: UpOp) -> None:
+        if self.clock.contains(op.dot):
+            return  # replay
+        # a deferred horizon that observed this dot kills it on arrival
+        if op.dot.counter <= self.deferred.get(op.key, {}).get(op.dot.actor, 0):
+            self.clock.apply(op.dot)
+            self._normalize_key(op.key)
+            return
+        birth = self.births.setdefault(op.key, {})
+        if op.dot.counter > birth.get(op.dot.actor, 0):
+            birth[op.dot.actor] = op.dot.counter
+        cls = self._child_type()[0]
+        child = self.vals.get(op.key)
+        if child is None:
+            child = self.vals[op.key] = cls()
+        child.apply(op.child_op)
+        self.clock.apply(op.dot)
+        self._normalize_key(op.key)
+
+    def _apply_rm(self, op: RmOp) -> None:
+        for key in op.keys:
+            birth = self.births.get(key)
+            if birth is not None:
+                for a in [
+                    a for a, c in birth.items() if c <= op.ctx.get(a)
+                ]:
+                    del birth[a]
+                child = self.vals.get(key)
+                if child is not None:
+                    child.reset_remove(op.ctx)
+                if not birth:
+                    self.births.pop(key, None)
+                    self.vals.pop(key, None)
+            # horizons beyond the clock defer (out-of-order cross-actor
+            # delivery: the remove observed dots we have not seen yet)
+            for a, c in op.ctx.counters.items():
+                if c > self.clock.get(a):
+                    dfr = self.deferred.setdefault(key, {})
+                    if c > dfr.get(a, 0):
+                        dfr[a] = c
+            self._normalize_key(key)
+
+    def _normalize_key(self, key) -> None:
+        dfr = self.deferred.get(key)
+        if dfr:
+            for a in [a for a, c in dfr.items() if c <= self.clock.get(a)]:
+                del dfr[a]
+            if not dfr:
+                del self.deferred[key]
+
+    # -- CvRDT -------------------------------------------------------------
+    #
+    # The survivor rule everywhere below relies on global dot uniqueness:
+    # a dot (actor, counter) names ONE map update, which targeted ONE key
+    # — so "dot covered by the other side's MAP clock, yet absent from
+    # the other side's state" can only mean observed-removed.  Child
+    # state therefore merges against the MAP clocks, not the children's
+    # own clocks (a remover's child forgot the removed dots via
+    # reset_remove, so its own clock cannot testify about them).
+    def merge(self, other: "CrdtMap") -> None:
+        if self.child != other.child:
+            raise ValueError("cannot merge maps with different child types")
+        keys = set(self.births) | set(other.births)
+        cls = self._child_type()[0]
+        new_births: dict = {}
+        new_vals: dict = {}
+        for key in keys:
+            ba = self.births.get(key, {})
+            bb = other.births.get(key, {})
+            # each side's removal knowledge for this key = its map clock
+            # extended by its deferred horizon (a remove OBSERVED those
+            # dots even when the clock has not caught up to them yet);
+            # copy only when a horizon exists — the common case reuses
+            # the clocks as-is
+            ca_eff, cb_eff = self.clock, other.clock
+            dfr = self.deferred.get(key)
+            if dfr:
+                ca_eff = ca_eff.copy()
+                for a, c in dfr.items():
+                    if c > ca_eff.get(a):
+                        ca_eff.counters[a] = c
+            dfr = other.deferred.get(key)
+            if dfr:
+                cb_eff = cb_eff.copy()
+                for a, c in dfr.items():
+                    if c > cb_eff.get(a):
+                        cb_eff.counters[a] = c
+            merged: dict = {}
+            for a in set(ba) | set(bb):
+                c = self._surv2(
+                    ba.get(a, 0), bb.get(a, 0),
+                    ca_eff.get(a), cb_eff.get(a),
+                )
+                if c:
+                    merged[a] = c
+            if not merged:
+                continue
+            va = self.vals.get(key)
+            vb = other.vals.get(key)
+            new_births[key] = merged
+            new_vals[key] = self._merge_child_ctx(
+                va if va is not None else cls(),
+                vb if vb is not None else cls(),
+                ca_eff, cb_eff,
+            )
+
+        # deferred horizons union by max
+        for key, dfr in other.deferred.items():
+            mine = self.deferred.setdefault(key, {})
+            for a, c in dfr.items():
+                if c > mine.get(a, 0):
+                    mine[a] = c
+
+        self.clock.merge(other.clock)
+        self.births = new_births
+        self.vals = new_vals
+        # retire satisfied horizons; apply surviving ones to merged state
+        for key in list(self.deferred):
+            dfr = self.deferred[key]
+            ctx = VClock({a: c for a, c in dfr.items()})
+            birth = self.births.get(key)
+            if birth is not None:
+                for a in [a for a, c in birth.items() if c <= ctx.get(a)]:
+                    del birth[a]
+                child = self.vals.get(key)
+                if child is not None:
+                    child.reset_remove(ctx)
+                if not birth:
+                    self.births.pop(key, None)
+                    self.vals.pop(key, None)
+            self._normalize_key(key)
+
+    @staticmethod
+    def _surv2(xa: int, xb: int, ca_r: int, cb_r: int) -> int:
+        """Per-actor survivor max: a side's value stands if both agree or
+        it is beyond the other side's map clock (else observed-removed)."""
+        surv_a = xa if (xa == xb or xa > cb_r) else 0
+        surv_b = xb if (xa == xb or xb > ca_r) else 0
+        return max(surv_a, surv_b)
+
+    def _merge_child_ctx(self, va, vb, ca: VClock, cb: VClock):
+        """Merge two child states under the MAP clocks (see merge())."""
+        if self.child == b"orset":
+            return self._merge_orset_ctx(va, vb, ca, cb)
+        if self.child == b"mvreg":
+            return self._merge_mvreg_ctx(va, vb, ca, cb)
+        if self.child == b"gcounter":
+            out = GCounter()
+            out.clock = self._merge_clock_ctx(va.clock, vb.clock, ca, cb)
+            return out
+        if self.child == b"pncounter":
+            out = PNCounter()
+            out.p.clock = self._merge_clock_ctx(va.p.clock, vb.p.clock, ca, cb)
+            out.n.clock = self._merge_clock_ctx(va.n.clock, vb.n.clock, ca, cb)
+            return out
+        raise ValueError(f"unknown child CRDT type {self.child!r}")
+
+    @classmethod
+    def _merge_clock_ctx(cls, a: VClock, b: VClock, ca: VClock, cb: VClock) -> VClock:
+        out = VClock()
+        for r in set(a.counters) | set(b.counters):
+            c = cls._surv2(a.get(r), b.get(r), ca.get(r), cb.get(r))
+            if c:
+                out.counters[r] = c
+        return out
+
+    @classmethod
+    def _merge_orset_ctx(cls, va: ORSet, vb: ORSet, ca: VClock, cb: VClock) -> ORSet:
+        out = ORSet()
+        for m in set(va.entries) | set(vb.entries):
+            ea, eb = va.entries.get(m, {}), vb.entries.get(m, {})
+            merged = {}
+            for r in set(ea) | set(eb):
+                c = cls._surv2(ea.get(r, 0), eb.get(r, 0), ca.get(r), cb.get(r))
+                if c:
+                    merged[r] = c
+            if merged:
+                out.entries[m] = merged
+        # remove horizons union by max…
+        for src in (va.deferred, vb.deferred):
+            for m, d in src.items():
+                slot = out.deferred.setdefault(m, {})
+                for r, c in d.items():
+                    if c > slot.get(r, 0):
+                        slot[r] = c
+        out.clock = cls._merge_clock_ctx(va.clock, vb.clock, ca, cb)
+        for m in list(set(out.entries) | set(out.deferred)):
+            out._normalize_member(m)
+        # …then retire any the merged MAP knowledge covers: a dot ≤ both
+        # effective clocks can never re-enter this child (the map-level
+        # survivor filter and replay gate both block it), and the fold
+        # side retired the same horizons through the child clock the
+        # map-level reset has since forgotten
+        mapk = ca.copy()
+        mapk.merge(cb)
+        for m in list(out.deferred):
+            d = out.deferred[m]
+            for r in [r for r, c in d.items() if c <= mapk.get(r)]:
+                del d[r]
+            if not d:
+                del out.deferred[m]
+        return out
+
+    @classmethod
+    def _merge_mvreg_ctx(cls, va: MVReg, vb: MVReg, ca: VClock, cb: VClock) -> MVReg:
+        def survivors(mine: MVReg, theirs: MVReg, their_map_clock: VClock):
+            out = []
+            for c, v in mine.vals:
+                if any(c == oc for oc, _ in theirs.vals):
+                    out.append((c.copy(), v))
+                    continue
+                dominated = any(oc.dominates(c) for oc, _ in theirs.vals)
+                if not dominated and not their_map_clock.descends(c):
+                    out.append((c.copy(), v))
+            return out
+
+        out = MVReg()
+        out.vals = survivors(va, vb, cb) + survivors(vb, va, ca)
+        out._canonicalize()
+        return out
+
+    # -- reads -------------------------------------------------------------
+    def get(self, key):
+        return self.vals.get(key)
+
+    def keys(self) -> list:
+        return sorted(self.births, key=codec.pack)
+
+    def contains(self, key) -> bool:
+        return key in self.births
+
+    # -- wire --------------------------------------------------------------
+    def op_to_obj(self, op):
+        return op.to_obj(self._child_type()[2])
+
+    def op_from_obj(self, obj):
+        if isinstance(obj, (UpOp, RmOp)):
+            return obj
+        kind = obj[0]
+        if kind == 0:
+            return UpOp(
+                Dot.from_obj(obj[1]), self._thaw_key(obj[2]),
+                self._child_type()[1](obj[3]),
+            )
+        if kind == 1:
+            return RmOp(
+                VClock.from_obj(obj[1]),
+                tuple(self._thaw_key(k) for k in obj[2]),
+            )
+        raise ValueError(f"bad CrdtMap op kind {kind!r}")
+
+    @staticmethod
+    def _thaw_key(key):
+        if isinstance(key, (bytearray, memoryview)):
+            return bytes(key)
+        if isinstance(key, list):
+            return tuple(key)
+        return key
+
+    def to_obj(self):
+        keys = self.keys()
+        cls = self._child_type()[0]
+        return [
+            self.child,
+            self.clock.to_obj(),
+            [
+                [
+                    k,
+                    {a: c for a, c in sorted(self.births[k].items())},
+                    self.vals[k].to_obj() if k in self.vals else cls().to_obj(),
+                ]
+                for k in keys
+            ],
+            [
+                [k, {a: c for a, c in sorted(d.items())}]
+                for k, d in sorted(
+                    self.deferred.items(), key=lambda kv: codec.pack(kv[0])
+                )
+            ],
+        ]
+
+    @classmethod
+    def from_obj(cls, obj) -> "CrdtMap":
+        child, clock, entries, deferred = obj
+        m = cls(child=bytes(child))
+        m.clock = VClock.from_obj(clock)
+        ctype = m._child_type()[0]
+        for k, birth, val in entries:
+            k = cls._thaw_key(k)
+            m.births[k] = {bytes(a): int(c) for a, c in birth.items()}
+            m.vals[k] = ctype.from_obj(val)
+        for k, d in deferred:
+            m.deferred[cls._thaw_key(k)] = {
+                bytes(a): int(c) for a, c in d.items()
+            }
+        return m

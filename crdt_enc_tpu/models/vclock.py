@@ -72,6 +72,13 @@ class VClock:
     def is_empty(self) -> bool:
         return not self.counters
 
+    def reset_remove(self, ctx: "VClock") -> None:
+        """Forget every event the removed context ``ctx`` observed: drop
+        per-actor counters ≤ ctx's (the ResetRemove protocol the causal
+        Map applies to its children — crdt_enc_tpu/models/crdtmap.py)."""
+        for a in [a for a, c in self.counters.items() if c <= ctx.get(a)]:
+            del self.counters[a]
+
     # canonical form: map actor → counter, zero entries dropped
     def to_obj(self):
         return {a: c for a, c in self.counters.items() if c > 0}

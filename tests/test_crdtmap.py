@@ -1,0 +1,283 @@
+"""Causal reset-remove map (models/crdtmap.py): observed-remove
+semantics with nested CRDT children.
+
+Ground truth is the CmRDT fold of an oracle-derived causally consistent
+history; convergence under adversarial interleavings, merge laws, and
+CmRDT/CvRDT agreement are all pinned against it — the same proof
+obligations every other model here carries, which matters doubly for the
+map because its merge implements the subtle cross-side reset rule
+(a remover's child forgot the removed dots, so the child-level clock
+filter alone cannot kill them on the other side)."""
+
+import asyncio
+import copy
+import uuid
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from crdt_enc_tpu.backends import (
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import Core, OpenOptions, map_adapter
+from crdt_enc_tpu.models import CrdtMap, canonical_bytes
+from crdt_enc_tpu.models.mvreg import MVRegOp
+from crdt_enc_tpu.models.orset import AddOp
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+ACTORS = [uuid.UUID(int=i + 1).bytes for i in range(4)]
+KEYS = ["k0", "k1", "k2"]
+MEMBERS = [10, 11, 12]
+
+
+def interleave(streams, rng):
+    streams = [list(s) for s in streams if s]
+    out = []
+    while streams:
+        i = rng.draw(st.integers(0, len(streams) - 1))
+        out.append(streams[i].pop(0))
+        if not streams[i]:
+            streams.pop(i)
+    return out
+
+
+# ---- history generation ----------------------------------------------------
+
+map_script = st.lists(
+    st.tuples(
+        st.integers(0, len(ACTORS) - 1),
+        st.sampled_from(["add", "rm_member", "rm_key", "write"]),
+        st.integers(0, len(KEYS) - 1),
+        st.integers(0, len(MEMBERS) - 1),
+    ),
+    max_size=24,
+)
+
+
+def orset_child_history(script):
+    """Map<orset> oracle + per-actor streams (rm_member exercises child
+    ops under the shared dot; rm_key the observed-remove)."""
+    oracle = CrdtMap(child=b"orset")
+    streams = {a: [] for a in ACTORS}
+    for actor_i, kind, key_i, member_i in script:
+        actor, key, member = ACTORS[actor_i], KEYS[key_i], MEMBERS[member_i]
+        if kind == "rm_key":
+            op = oracle.rm_ctx(key)
+            if op.ctx.is_empty():
+                continue
+        elif kind == "add":
+            op = oracle.update_ctx(
+                actor, key,
+                lambda child, dot: AddOp(member, dot),
+            )
+        elif kind == "rm_member":
+            child = oracle.get(key)
+            if child is None or not child.contains(member):
+                continue
+            op = oracle.update_ctx(
+                actor, key,
+                lambda child, dot: child.rm_ctx(member),
+            )
+        else:  # write → treat as add of a different member
+            op = oracle.update_ctx(
+                actor, key,
+                lambda child, dot: AddOp(member + 100, dot),
+            )
+        oracle.apply(op)
+        streams[actor].append(op)
+    return oracle, [s for s in streams.values() if s]
+
+
+def mvreg_child_history(script):
+    oracle = CrdtMap(child=b"mvreg")
+    streams = {a: [] for a in ACTORS}
+    for actor_i, kind, key_i, val in script:
+        actor, key = ACTORS[actor_i], KEYS[key_i]
+        if kind == "rm_key":
+            op = oracle.rm_ctx(key)
+            if op.ctx.is_empty():
+                continue
+        else:
+            def build(child, dot, val=val):
+                clock = child.read().clock
+                clock.apply(dot)
+                return MVRegOp(clock, val)
+
+            op = oracle.update_ctx(actor, key, build)
+        oracle.apply(op)
+        streams[actor].append(op)
+    return oracle, [s for s in streams.values() if s]
+
+
+HISTORIES = {"orset": orset_child_history, "mvreg": mvreg_child_history}
+
+
+# ---- laws ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("child", ["orset", "mvreg"])
+@settings(max_examples=120, deadline=None)
+@given(script=map_script, data=st.data())
+def test_map_convergence_under_interleaving(child, script, data):
+    oracle, streams = HISTORIES[child](script)
+    replica = CrdtMap(child=child.encode())
+    for op in interleave(streams, data):
+        replica.apply(op)
+    assert canonical_bytes(replica) == canonical_bytes(oracle)
+    # wire round-trip
+    assert canonical_bytes(
+        CrdtMap.from_obj(replica.to_obj())
+    ) == canonical_bytes(oracle)
+
+
+@pytest.mark.parametrize("child", ["orset", "mvreg"])
+@settings(max_examples=120, deadline=None)
+@given(script=map_script, data=st.data())
+def test_map_cm_cv_agreement_and_merge_laws(child, script, data):
+    oracle, streams = HISTORIES[child](script)
+    replicas = []
+    for s in streams:
+        r = CrdtMap(child=child.encode())
+        for op in s:
+            r.apply(op)
+        replicas.append(r)
+    if not replicas:
+        return
+    # merging per-actor replicas in any order equals the oracle fold
+    order = interleave([[i] for i in range(len(replicas))], data)
+    merged = CrdtMap(child=child.encode())
+    for i in order:
+        merged.merge(replicas[i])
+    assert canonical_bytes(merged) == canonical_bytes(oracle)
+    # commutativity + idempotence
+    a, b = copy.deepcopy(replicas[0]), copy.deepcopy(replicas[-1])
+    ab, ba = copy.deepcopy(a), copy.deepcopy(b)
+    ab.merge(b)
+    ba.merge(a)
+    assert canonical_bytes(ab) == canonical_bytes(ba)
+    ab2 = copy.deepcopy(ab)
+    ab2.merge(b)
+    assert canonical_bytes(ab2) == canonical_bytes(ab)
+
+
+# ---- targeted semantics ----------------------------------------------------
+
+
+def test_observed_remove_spares_concurrent_update():
+    """rm(key) on A must not delete B's concurrent update to that key."""
+    a = CrdtMap(child=b"orset")
+    b = CrdtMap(child=b"orset")
+    up = a.update_ctx(ACTORS[0], "k", lambda c, d: AddOp(1, d))
+    a.apply(up)
+    b.apply(up)
+    # concurrent: A removes k; B adds member 2 under k
+    rm = a.rm_ctx("k")
+    upb = b.update_ctx(ACTORS[1], "k", lambda c, d: AddOp(2, d))
+    a.apply(rm)
+    b.apply(upb)
+    a.merge(b)
+    b.apply(rm)
+    assert canonical_bytes(a) == canonical_bytes(b)
+    assert a.contains("k")
+    assert a.get("k").contains(2)  # concurrent add survives
+    assert not a.get("k").contains(1)  # observed state removed
+
+
+def test_remove_observed_via_merge_kills_other_sides_copy():
+    """The cross-side reset rule: B's copy of observed-removed child
+    state dies in the merge even though the remover's child forgot it."""
+    a = CrdtMap(child=b"orset")
+    b = CrdtMap(child=b"orset")
+    up1 = a.update_ctx(ACTORS[0], "k", lambda c, d: AddOp(1, d))
+    a.apply(up1)
+    b.apply(up1)
+    rm = a.rm_ctx("k")
+    a.apply(rm)  # A: key gone entirely
+    assert not a.contains("k")
+    a.merge(b)  # B still has the old copy — must NOT resurrect
+    assert not a.contains("k")
+    # and the reverse merge converges identically
+    b.merge(a)
+    assert canonical_bytes(b) == canonical_bytes(a)
+
+
+def test_deferred_remove_beyond_local_clock():
+    """A remove whose context cites dots this replica has not seen yet
+    suppresses those dots when they arrive (same contract as the ORSet's
+    deferred horizons)."""
+    a = CrdtMap(child=b"orset")
+    b = CrdtMap(child=b"orset")
+    up1 = a.update_ctx(ACTORS[0], "k", lambda c, d: AddOp(1, d))
+    a.apply(up1)
+    rm = a.rm_ctx("k")  # observed {actor0: 1}
+    # b receives the remove BEFORE the update it observed
+    b.apply(rm)
+    assert not b.contains("k")
+    b.apply(up1)  # arrives late: born dead
+    assert not b.contains("k")
+    a.apply(rm)
+    assert canonical_bytes(b) == canonical_bytes(a)
+
+
+# ---- Core lifecycle --------------------------------------------------------
+
+
+def test_core_lifecycle_map():
+    async def go():
+        def opts(remote):
+            return OpenOptions(
+                storage=MemoryStorage(remote),
+                cryptor=IdentityCryptor(),
+                key_cryptor=PlainKeyCryptor(),
+                adapter=map_adapter(b"orset"),
+                supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+                current_data_version=DEFAULT_DATA_VERSION_1,
+                create=True,
+            )
+
+        remote = MemoryRemote()
+        w = await Core.open(opts(remote))
+        await w.update(
+            lambda s: s.update_ctx(w.actor_id, "fruits", lambda c, d: AddOp("apple", d))
+        )
+        await w.update(
+            lambda s: s.update_ctx(w.actor_id, "fruits", lambda c, d: AddOp("pear", d))
+        )
+        await w.update(
+            lambda s: s.update_ctx(w.actor_id, "nums", lambda c, d: AddOp(1, d))
+        )
+        await w.update(lambda s: s.rm_ctx("nums"))
+        await w.compact()
+        r = await Core.open(opts(remote))
+        await r.read_remote()
+        assert r.with_state(lambda s: s.keys()) == ["fruits"]
+        assert r.with_state(lambda s: sorted(s.get("fruits").members()))
+        assert r.with_state(canonical_bytes) == w.with_state(canonical_bytes)
+
+    asyncio.run(go())
+
+
+def test_counter_child_reset_remove_and_merge():
+    """Map<pncounter>: removing a key resets the observed count; a
+    concurrent increment survives the remove."""
+    from crdt_enc_tpu.models.counters import POS
+
+    a = CrdtMap(child=b"pncounter")
+    b = CrdtMap(child=b"pncounter")
+    up1 = a.update_ctx(ACTORS[0], "hits", lambda c, d: (POS, d))
+    a.apply(up1)
+    b.apply(up1)
+    assert a.get("hits").read() == 1
+    rm = a.rm_ctx("hits")
+    upb = b.update_ctx(ACTORS[1], "hits", lambda c, d: (POS, d))
+    a.apply(rm)
+    b.apply(upb)
+    assert not a.contains("hits")
+    a.merge(b)
+    b.apply(rm)
+    assert canonical_bytes(a) == canonical_bytes(b)
+    # the concurrent increment survives; the observed one was removed
+    assert a.contains("hits") and a.get("hits").read() == 1

@@ -1,0 +1,337 @@
+"""Property tests for the round-2 model additions (GSet, LWWReg,
+MerkleReg, SeqList): CRDT laws under adversarial interleavings, plus a
+full Core lifecycle per type — same strategy as tests/test_crdt_laws.py
+(oracle-derived causally consistent histories, per-actor order
+preserved, cross-actor interleaving chosen by hypothesis)."""
+
+import asyncio
+import copy
+import uuid
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from crdt_enc_tpu.backends import (
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import (
+    Core,
+    OpenOptions,
+    gset_adapter,
+    list_adapter,
+    lwwreg_adapter,
+    merklereg_adapter,
+)
+from crdt_enc_tpu.models import (
+    GSet,
+    LWWReg,
+    MerkleReg,
+    SeqList,
+    canonical_bytes,
+)
+from crdt_enc_tpu.models.seqlist import path_between
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+ACTORS = [uuid.UUID(int=i + 1).bytes for i in range(4)]
+
+
+def interleave(streams, rng):
+    streams = [list(s) for s in streams if s]
+    out = []
+    while streams:
+        i = rng.draw(st.integers(0, len(streams) - 1))
+        out.append(streams[i].pop(0))
+        if not streams[i]:
+            streams.pop(i)
+    return out
+
+
+def merge_laws(states, make_new):
+    """Commutativity, associativity, idempotence over the given states."""
+    a, b = copy.deepcopy(states[0]), copy.deepcopy(states[-1])
+    ab, ba = copy.deepcopy(a), copy.deepcopy(b)
+    ab.merge(b)
+    ba.merge(a)
+    assert canonical_bytes(ab) == canonical_bytes(ba)  # commutative
+    ab2 = copy.deepcopy(ab)
+    ab2.merge(b)
+    assert canonical_bytes(ab2) == canonical_bytes(ab)  # idempotent
+    if len(states) >= 3:
+        x, y, z = (copy.deepcopy(s) for s in states[:3])
+        left = copy.deepcopy(x)
+        left.merge(y)
+        left.merge(z)
+        yz = copy.deepcopy(y)
+        yz.merge(z)
+        right = copy.deepcopy(x)
+        right.merge(yz)
+        assert canonical_bytes(left) == canonical_bytes(right)  # associative
+
+
+# ---- SeqList --------------------------------------------------------------
+
+list_script = st.lists(
+    st.tuples(
+        st.integers(0, len(ACTORS) - 1),
+        st.sampled_from(["ins", "del"]),
+        st.integers(0, 10),
+        st.integers(0, 99),
+    ),
+    max_size=24,
+)
+
+
+def list_history(script):
+    oracle = SeqList()
+    streams = {a: [] for a in ACTORS}
+    for actor_i, kind, pos, val in script:
+        actor = ACTORS[actor_i]
+        if kind == "ins":
+            op = oracle.insert_ctx(actor, pos % (len(oracle) + 1), val)
+        else:
+            if len(oracle) == 0:
+                continue
+            op = oracle.delete_ctx(pos % len(oracle))
+        oracle.apply(op)
+        streams[actor].append(op)
+    return oracle, [s for s in streams.values() if s]
+
+
+@settings(max_examples=150, deadline=None)
+@given(list_script, st.data())
+def test_list_convergence_under_interleaving(script, data):
+    oracle, streams = list_history(script)
+    replica = SeqList()
+    for op in interleave(streams, data):
+        replica.apply(op)
+    assert canonical_bytes(replica) == canonical_bytes(oracle)
+    # wire round-trip
+    assert canonical_bytes(
+        SeqList.from_obj(replica.to_obj())
+    ) == canonical_bytes(oracle)
+
+
+@settings(max_examples=80, deadline=None)
+@given(list_script, st.data())
+def test_list_merge_laws_and_cm_cv_agreement(script, data):
+    oracle, streams = list_history(script)
+    replicas = []
+    for s in streams:
+        r = SeqList()
+        for op in s:
+            r.apply(op)
+        replicas.append(r)
+    if not replicas:
+        return
+    merge_laws(replicas, SeqList)
+    merged = SeqList()
+    for r in replicas:
+        merged.merge(r)
+    assert canonical_bytes(merged) == canonical_bytes(oracle)
+
+
+def test_list_sequential_editing_semantics():
+    """Single-writer editing behaves like a plain list."""
+    a = ACTORS[0]
+    lst = SeqList()
+    for i, ch in enumerate("hello"):
+        lst.apply(lst.insert_ctx(a, i, ch))
+    assert lst.read() == list("hello")
+    lst.apply(lst.insert_ctx(a, 0, ">"))
+    assert lst.read() == list(">hello")
+    lst.apply(lst.delete_ctx(3))  # drop the first 'l'
+    assert lst.read() == list(">helo")
+    lst.apply(lst.insert_ctx(a, 5, "!"))
+    assert lst.read() == list(">helo!")
+
+
+def test_path_between_is_dense_and_ordered():
+    lo = ()
+    ids = []
+    for _ in range(200):  # repeated head-insert exercises level growth
+        lo_new = path_between((), ids[0] if ids else None)
+        ids.insert(0, lo_new)
+    assert ids == sorted(ids)
+    assert len(set(ids)) == len(ids)
+    mid = path_between(ids[3], ids[4])
+    assert ids[3] < mid < ids[4]
+
+
+# ---- GSet -----------------------------------------------------------------
+
+gset_script = st.lists(
+    st.tuples(st.integers(0, 3), st.integers(0, 9)), max_size=20
+)
+
+
+@settings(max_examples=100, deadline=None)
+@given(gset_script, st.data())
+def test_gset_laws(script, data):
+    oracle = GSet()
+    streams = {a: [] for a in ACTORS}
+    for actor_i, member in script:
+        op = oracle.insert_ctx(member)
+        oracle.apply(op)
+        streams[ACTORS[actor_i]].append(op)
+    replica = GSet()
+    for op in interleave(list(streams.values()), data):
+        replica.apply(op)
+    assert canonical_bytes(replica) == canonical_bytes(oracle)
+    replicas = []
+    for s in streams.values():
+        r = GSet()
+        for op in s:
+            r.apply(op)
+        replicas.append(r)
+    merge_laws(replicas, GSet)
+    assert canonical_bytes(GSet.from_obj(oracle.to_obj())) == canonical_bytes(oracle)
+
+
+# ---- LWWReg ---------------------------------------------------------------
+
+lww_script = st.lists(
+    st.tuples(st.integers(0, 3), st.integers(0, 5), st.integers(0, 99)),
+    max_size=20,
+)
+
+
+@settings(max_examples=100, deadline=None)
+@given(lww_script, st.data())
+def test_lwwreg_laws(script, data):
+    oracle = LWWReg()
+    ops = []
+    for actor_i, ts, val in script:
+        op = oracle.write(ts, ACTORS[actor_i], val)
+        oracle.apply(op)
+        ops.append(op)
+    replica = LWWReg()
+    for op in interleave([ops[::2], ops[1::2]], data):
+        replica.apply(op)
+    assert canonical_bytes(replica) == canonical_bytes(oracle)
+    replicas = []
+    for chunk in (ops[::3], ops[1::3], ops[2::3]):
+        r = LWWReg()
+        for op in chunk:
+            r.apply(op)
+        replicas.append(r)
+    if replicas:
+        merge_laws(replicas, LWWReg)
+    assert canonical_bytes(LWWReg.from_obj(oracle.to_obj())) == canonical_bytes(oracle)
+
+
+# ---- MerkleReg ------------------------------------------------------------
+
+
+def test_merklereg_supersession_and_concurrency():
+    a, b = MerkleReg(), MerkleReg()
+    w1 = a.write_ctx("v1")
+    a.apply(w1)
+    b.apply(w1)
+    # concurrent writes on top of v1
+    wa = a.write_ctx("va")
+    wb = b.write_ctx("vb")
+    a.apply(wa)
+    b.apply(wb)
+    a.merge(b)
+    b.merge(a)
+    assert canonical_bytes(a) == canonical_bytes(b)
+    assert sorted(a.read()) == ["va", "vb"]  # two heads
+    # a citing write resolves both heads
+    w2 = a.write_ctx("resolved")
+    a.apply(w2)
+    assert a.read() == ["resolved"]
+    b.apply(w2)
+    assert canonical_bytes(b) == canonical_bytes(a)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(st.integers(0, 99), min_size=1, max_size=12), st.data())
+def test_merklereg_laws(vals, data):
+    oracle = MerkleReg()
+    ops = []
+    for v in vals:
+        op = oracle.write_ctx(v)
+        oracle.apply(op)
+        ops.append(op)
+    replica = MerkleReg()
+    for op in interleave([ops[::2], ops[1::2]], data):
+        replica.apply(op)
+    assert canonical_bytes(replica) == canonical_bytes(oracle)
+    r1, r2 = MerkleReg(), MerkleReg()
+    for op in ops[::2]:
+        r1.apply(op)
+    for op in ops[1::2]:
+        r2.apply(op)
+    merge_laws([r1, r2], MerkleReg)
+    assert canonical_bytes(
+        MerkleReg.from_obj(oracle.to_obj())
+    ) == canonical_bytes(oracle)
+
+
+# ---- Core lifecycle per type ----------------------------------------------
+
+
+def _opts(remote, adapter):
+    return OpenOptions(
+        storage=MemoryStorage(remote),
+        cryptor=IdentityCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=adapter,
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=True,
+    )
+
+
+@pytest.mark.parametrize(
+    "adapter_fn,builders,expect",
+    [
+        (
+            gset_adapter,
+            [lambda c, s, i=i: s.insert_ctx(i) for i in (3, 1, 2)],
+            lambda s: s.read() == [1, 2, 3],
+        ),
+        (
+            lwwreg_adapter,
+            [
+                lambda c, s: s.write(1, c.actor_id, "old"),
+                lambda c, s: s.write(9, c.actor_id, "new"),
+            ],
+            lambda s: s.read() == "new",
+        ),
+        (
+            merklereg_adapter,
+            [lambda c, s: s.write_ctx("x")],
+            lambda s: s.read() == ["x"],
+        ),
+        (
+            list_adapter,
+            [
+                lambda c, s: s.insert_ctx(c.actor_id, 0, "b"),
+                lambda c, s: s.insert_ctx(c.actor_id, 0, "a"),
+            ],
+            lambda s: s.read() == ["a", "b"],
+        ),
+    ],
+    ids=["gset", "lwwreg", "merklereg", "list"],
+)
+def test_core_lifecycle_new_types(adapter_fn, builders, expect):
+    async def go():
+        remote = MemoryRemote()
+        writer = await Core.open(_opts(remote, adapter_fn()))
+        # derive-then-apply one op at a time: each derivation must see the
+        # previous op applied (update() persists and folds the result)
+        for build in builders:
+            await writer.update(lambda s, b=build: b(writer, s))
+        await writer.compact()
+        reader = await Core.open(_opts(remote, adapter_fn()))
+        await reader.read_remote()
+        assert reader.with_state(expect)
+        assert reader.with_state(canonical_bytes) == writer.with_state(
+            canonical_bytes
+        )
+
+    asyncio.run(go())
