@@ -134,6 +134,17 @@ def _bind(lib) -> None:
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
     ]
     lib.counter_decode_batch.restype = ctypes.c_int64
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.orset_host_reduce.argtypes = [
+        ctypes.POINTER(ctypes.c_int8), i32p, i32p, i32p, ctypes.c_int64,
+        i32p, ctypes.c_int32, ctypes.c_int64, i32p, i32p,
+    ]
+    lib.orset_host_reduce.restype = ctypes.c_int64
+    lib.intern_spans_native.argtypes = [
+        u8p, u64p, u64p, ctypes.c_int64, i64p, ctypes.c_int64,
+        i32p, u64p, u64p, ctypes.c_int64,
+    ]
+    lib.intern_spans_native.restype = ctypes.c_int64
 
 
 

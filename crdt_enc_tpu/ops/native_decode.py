@@ -122,8 +122,48 @@ def combine_orset_spans(parts: list):
 
 
 def intern_spans(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
-    """Vectorized span interning: rows → dense member indices + decoded
-    unique member objects.  Groups rows by span length; spans of ≤ 8 bytes
+    """Span interning: rows → dense member indices + decoded unique member
+    objects.  The native open-addressing hash pass costs one linear scan
+    (the numpy fallback below sorts 8 bytes per row — measured ~8× slower
+    at the 8M-row e2e scale); unique spans then decode via codec, a few
+    thousand objects at most."""
+    n = len(off)
+    if n == 0:
+        return np.zeros(0, np.int32), []
+    if (np.asarray(length) == 0).any():
+        raise ValueError("empty member span")
+    try:
+        lib = native.load()
+        off64 = np.ascontiguousarray(off, np.uint64)
+        len64 = np.ascontiguousarray(length, np.uint64)
+        cap = 1 << max(11, (2 * n - 1).bit_length())
+        table = np.full(cap, -1, np.int64)
+        idx = np.zeros(n, np.int32)
+        uniq_off = np.zeros(n, np.uint64)
+        uniq_len = np.zeros(n, np.uint64)
+        bp = buf.ctypes.data_as(native.u8p)
+        got = lib.intern_spans_native(
+            bp, off64.ctypes.data_as(native.u64p),
+            len64.ctypes.data_as(native.u64p), n,
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap,
+            idx.ctypes.data_as(_i32p),
+            uniq_off.ctypes.data_as(native.u64p),
+            uniq_len.ctypes.data_as(native.u64p), n,
+        )
+    except RuntimeError:  # native lib unavailable
+        got = -1
+    if got >= 0:
+        mv = memoryview(np.ascontiguousarray(buf))
+        members = [
+            codec.unpack(mv[int(o) : int(o) + int(ln)])
+            for o, ln in zip(uniq_off[:got].tolist(), uniq_len[:got].tolist())
+        ]
+        return idx, members
+    return _intern_spans_numpy(buf, off, length)
+
+
+def _intern_spans_numpy(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
+    """Vectorized fallback: groups rows by span length; spans of ≤ 8 bytes
     (the overwhelmingly common case — small ints, short bytes) pack into
     uint64 so ``np.unique`` sorts scalars (~10× faster than the byte-matrix
     ``axis=0`` path, which argsorts rows); longer spans take the matrix

@@ -257,10 +257,44 @@ class OrsetFoldSession:
 
     def _host_reduce(self, kind, member, actor, counter) -> None:
         """The leaf-level fold on host: exactly orset_fold's masked
-        scatter-max (ops/orset.py:84-131), via np.maximum.at."""
+        scatter-max (ops/orset.py:84-131).  One native linear pass
+        (np.maximum.at is a buffered ufunc, ~10× slower at these scales);
+        the numpy form remains as fallback."""
         if len(self.members) > self._h_add.shape[0]:
             self._grow_host_planes()
         with trace.span("session.host_reduce"):
+            try:
+                from .. import native
+
+                lib = native.load()
+                import ctypes
+
+                i32p = ctypes.POINTER(ctypes.c_int32)
+                i8p = ctypes.POINTER(ctypes.c_int8)
+                kind_c = np.ascontiguousarray(kind, np.int8)
+                member_c = np.ascontiguousarray(member, np.int32)
+                actor_c = np.ascontiguousarray(actor, np.int32)
+                counter_c = np.ascontiguousarray(counter, np.int32)
+                clock_c = np.ascontiguousarray(self._clock0, np.int32)
+                oob = lib.orset_host_reduce(
+                    kind_c.ctypes.data_as(i8p),
+                    member_c.ctypes.data_as(i32p),
+                    actor_c.ctypes.data_as(i32p),
+                    counter_c.ctypes.data_as(i32p),
+                    len(kind_c),
+                    clock_c.ctypes.data_as(i32p),
+                    self.R,
+                    self._h_add.shape[0],
+                    self._h_add.ctypes.data_as(i32p),
+                    self._h_rm.ctypes.data_as(i32p),
+                )
+                if oob:
+                    raise AssertionError(
+                        f"{oob} rows outside the host planes (sizing bug)"
+                    )
+                return
+            except RuntimeError:  # native lib unavailable: numpy fallback
+                pass
             valid = actor < self.R
             seen = counter <= self._clock0[np.minimum(actor, self.R - 1)]
             live_add = (kind == KIND_ADD) & valid & ~seen

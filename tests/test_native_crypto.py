@@ -47,11 +47,15 @@ def _ietf_decrypt(key, nonce, aad, ct):
 def test_ietf_matches_cryptography_wheel():
     from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
+    # sizes straddle the 8-block SIMD lane boundary (512 bytes): the lane
+    # path must match the oracle, not just roundtrip against itself —
+    # a symmetric lane/counter permutation would pass a self-roundtrip
+    sizes = [0, 1, 63, 64, 300, 511, 512, 513, 1024, 4096, 100_000]
     for trial in range(20):
         key = secrets.token_bytes(32)
         nonce = secrets.token_bytes(12)
         aad = secrets.token_bytes(trial % 7 * 5)
-        pt = secrets.token_bytes(trial * 37 % 301)
+        pt = secrets.token_bytes(sizes[trial % len(sizes)] + trial * 37 % 301)
         oracle = ChaCha20Poly1305(key).encrypt(nonce, pt, aad or None)
         ours = _ietf_encrypt(key, nonce, aad, pt)
         assert ours == oracle
