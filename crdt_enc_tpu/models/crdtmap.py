@@ -6,21 +6,24 @@ themselves CRDTs, where removing a key deletes exactly the causal
 history the remover had *observed* — updates concurrent with the remove
 survive (observed-remove, the same add-wins discipline as the ORSet),
 and the nested value forgets only the removed context
-(``reset_remove``, implemented by every causal child type here).
+(``reset_remove``).
 
 Dot discipline (mirrors the crate's ctx protocol): ONE dot per update
 authorizes both the map entry (the key's "birth" dots) and the child
 mutation — the child op builder receives that dot, so map-level replay
-protection and removal cover the child coherently.
+protection and removal cover the child coherently.  See ``CHILD_TYPES``
+for why the ORSet is the one child this stays coherent for.
 
 Structure parallels the tombstone-free ORSet (models/orset.py): per-key
-birth dots as dense per-actor maxima, deferred remove horizons for
-contexts beyond the local clock, one global clock.  The CvRDT merge uses
-the same clock-filter survivor rule; CmRDT/CvRDT agreement is pinned by
-the property tests against oracle-folded histories.
-
-Child types must provide ``apply``, ``merge``, ``reset_remove``,
-``to_obj``/``from_obj`` and an op decoder — see ``CHILD_TYPES``.
+birth dots as dense per-actor maxima, one global clock — but removes
+whose context cites unseen dots defer as WHOLE ops, not per-actor
+horizons, and a child's remove-horizons retire against the MAP clock.
+Both rules exist because the transport is per-actor FIFO, *not* causal:
+each was driven by a concrete divergence found under true-concurrency
+fuzzing (ops derived from divergent replicas, gossiped out of causal
+order) — the oracle-based law tests alone cannot reach those states.
+CmRDT/CvRDT agreement, adversarial interleavings, and the
+true-concurrency class are all pinned in tests/test_crdtmap.py.
 """
 
 from __future__ import annotations
@@ -28,31 +31,33 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..utils import codec
-from .counters import GCounter, PNCounter
-from .mvreg import MVReg, MVRegOp
 from .orset import ORSet
 from .orset import op_from_obj as orset_op_from_obj
 from .vclock import Actor, Dot, VClock
 
 
-def _pn_op_from_obj(obj):
-    return (int(obj[0]), Dot.from_obj(obj[1]))
-
-
-def _pn_op_to_obj(op):
-    return [op[0], op[1].to_obj()]
-
-
 # child registry: name -> (type, op_from_obj, op_to_obj)
+#
+# The ORSet is the one child whose dot discipline is coherent under the
+# map (the crate's canonical Orswot-in-map usage): a child add's dot IS
+# the map dot, so map-level replay gates, resets, and the merge's
+# clock-coverage arguments all see one consistent dot space.  Two
+# families are deliberately absent, each verified non-convergent by
+# fuzzing before exclusion:
+#
+# * MVReg — its unit of state is a (context-clock, value) pair; a
+#   key-remove's reset shrinks pair clocks, two distinct writes can
+#   collapse onto one clock, and no merge rule can then tell their
+#   histories apart (re-merges resurrect dead dots).  The external
+#   crate's MVReg-in-map shares these corners under the non-causal
+#   delivery this framework's file-sync transport provides.
+# * Counters — shared map dots corrupt counts (max-dot ≠ op count when
+#   an actor alternates inc/dec), and child-local dots break the shared
+#   dot space the reset rules need.
+#
+# A register- or counter-per-key is served by LWWMap or separate Cores.
 CHILD_TYPES = {
     b"orset": (ORSet, orset_op_from_obj, lambda op: op.to_obj()),
-    b"mvreg": (
-        MVReg,
-        lambda obj: MVRegOp(VClock.from_obj(obj[0]), obj[1]),
-        lambda op: [op.clock.to_obj(), op.value],
-    ),
-    b"gcounter": (GCounter, Dot.from_obj, lambda op: op.to_obj()),
-    b"pncounter": (PNCounter, _pn_op_from_obj, _pn_op_to_obj),
 }
 
 
@@ -89,7 +94,13 @@ class CrdtMap:
     births: dict = field(default_factory=dict)
     # key -> child CRDT state
     vals: dict = field(default_factory=dict)
-    # key -> {actor: remove horizon beyond the clock}
+    # pending whole removes whose context cites dots beyond the clock:
+    # canonical-ctx-bytes -> (VClock, set of keys).  Deferring the WHOLE
+    # op (the crdts-crate discipline) — not per-actor horizons — is what
+    # keeps non-causal delivery convergent: a remove fires only once
+    # every update it observed has arrived, so the updates' child
+    # sub-ops (e.g. a child remove citing an actor the remover never
+    # saw) are never lost to suppression.
     deferred: dict = field(default_factory=dict)
 
     def __post_init__(self):
@@ -134,11 +145,6 @@ class CrdtMap:
     def _apply_up(self, op: UpOp) -> None:
         if self.clock.contains(op.dot):
             return  # replay
-        # a deferred horizon that observed this dot kills it on arrival
-        if op.dot.counter <= self.deferred.get(op.key, {}).get(op.dot.actor, 0):
-            self.clock.apply(op.dot)
-            self._normalize_key(op.key)
-            return
         birth = self.births.setdefault(op.key, {})
         if op.dot.counter > birth.get(op.dot.actor, 0):
             birth[op.dot.actor] = op.dot.counter
@@ -148,38 +154,79 @@ class CrdtMap:
             child = self.vals[op.key] = cls()
         child.apply(op.child_op)
         self.clock.apply(op.dot)
-        self._normalize_key(op.key)
+        # retire child remove-horizons the MAP clock covers: child dots
+        # are key-bound, so a cited dot ≤ the map clock either reached
+        # this child incarnation (its own normalize handles it) or
+        # belonged to a previous incarnation a key-remove consumed —
+        # either way it can never arrive again (per-actor FIFO + replay
+        # gate), and keeping it would diverge from replicas that saw the
+        # dot before the key died
+        self._retire_child_horizons(child)
+        self._flush_deferred()
+
+    def _retire_child_horizons(self, child) -> None:
+        dfr = getattr(child, "deferred", None)
+        if not dfr:
+            return
+        clock = self.clock
+        for m in list(dfr):
+            d = dfr[m]
+            for a in [a for a, c in d.items() if c <= clock.get(a)]:
+                del d[a]
+            if not d:
+                del dfr[m]
 
     def _apply_rm(self, op: RmOp) -> None:
-        for key in op.keys:
-            birth = self.births.get(key)
-            if birth is not None:
-                for a in [
-                    a for a, c in birth.items() if c <= op.ctx.get(a)
-                ]:
-                    del birth[a]
-                child = self.vals.get(key)
-                if child is not None:
-                    child.reset_remove(op.ctx)
-                if not birth:
-                    self.births.pop(key, None)
-                    self.vals.pop(key, None)
-            # horizons beyond the clock defer (out-of-order cross-actor
-            # delivery: the remove observed dots we have not seen yet)
-            for a, c in op.ctx.counters.items():
-                if c > self.clock.get(a):
-                    dfr = self.deferred.setdefault(key, {})
-                    if c > dfr.get(a, 0):
-                        dfr[a] = c
-            self._normalize_key(key)
+        if self.clock.descends(op.ctx):
+            self._rm_now(op.ctx, op.keys)
+        else:
+            self._defer(op.ctx, op.keys)
 
-    def _normalize_key(self, key) -> None:
-        dfr = self.deferred.get(key)
-        if dfr:
-            for a in [a for a, c in dfr.items() if c <= self.clock.get(a)]:
-                del dfr[a]
-            if not dfr:
-                del self.deferred[key]
+    def _rm_now(self, ctx: VClock, keys) -> None:
+        for key in keys:
+            birth = self.births.get(key)
+            child = self.vals.get(key)
+            if birth is None and child is None:
+                continue
+            if birth is not None:
+                for a in [a for a, c in birth.items() if c <= ctx.get(a)]:
+                    del birth[a]
+            if child is not None:
+                child.reset_remove(ctx)
+            if not birth:
+                self.births.pop(key, None)
+                # the child may hold RESIDUE the key's death must not
+                # erase: remove horizons citing dots this replica has not
+                # seen (delivery is per-actor FIFO, not causal — an
+                # arriving update's child sub-ops can reference actors
+                # the key-remover never saw).  Without the residue,
+                # replicas that got the remove first would resurrect
+                # state that replicas who saw the update first killed.
+                if child is not None and not self._child_residue(child):
+                    self.vals.pop(key, None)
+
+    def _child_residue(self, child) -> bool:
+        return child.to_obj() != self._child_type()[0]().to_obj()
+
+    def _defer(self, ctx: VClock, keys) -> None:
+        tag = codec.pack(ctx.to_obj())
+        slot = self.deferred.get(tag)
+        if slot is None:
+            self.deferred[tag] = (ctx.copy(), set(keys))
+        else:
+            slot[1].update(keys)
+
+    def _flush_deferred(self) -> None:
+        """Fire every pending remove whose cited history has now fully
+        arrived (called after each clock advance and after merges)."""
+        if not self.deferred:
+            return
+        for tag in [
+            t for t, (ctx, _) in self.deferred.items()
+            if self.clock.descends(ctx)
+        ]:
+            ctx, keys = self.deferred.pop(tag)
+            self._rm_now(ctx, keys)
 
     # -- CvRDT -------------------------------------------------------------
     #
@@ -193,75 +240,51 @@ class CrdtMap:
     def merge(self, other: "CrdtMap") -> None:
         if self.child != other.child:
             raise ValueError("cannot merge maps with different child types")
-        keys = set(self.births) | set(other.births)
+        keys = (
+            set(self.births) | set(other.births)
+            | set(self.vals) | set(other.vals)  # residue-only keys too
+        )
         cls = self._child_type()[0]
         new_births: dict = {}
         new_vals: dict = {}
         for key in keys:
             ba = self.births.get(key, {})
             bb = other.births.get(key, {})
-            # each side's removal knowledge for this key = its map clock
-            # extended by its deferred horizon (a remove OBSERVED those
-            # dots even when the clock has not caught up to them yet);
-            # copy only when a horizon exists — the common case reuses
-            # the clocks as-is
-            ca_eff, cb_eff = self.clock, other.clock
-            dfr = self.deferred.get(key)
-            if dfr:
-                ca_eff = ca_eff.copy()
-                for a, c in dfr.items():
-                    if c > ca_eff.get(a):
-                        ca_eff.counters[a] = c
-            dfr = other.deferred.get(key)
-            if dfr:
-                cb_eff = cb_eff.copy()
-                for a, c in dfr.items():
-                    if c > cb_eff.get(a):
-                        cb_eff.counters[a] = c
             merged: dict = {}
             for a in set(ba) | set(bb):
                 c = self._surv2(
                     ba.get(a, 0), bb.get(a, 0),
-                    ca_eff.get(a), cb_eff.get(a),
+                    self.clock.get(a), other.clock.get(a),
                 )
                 if c:
                     merged[a] = c
-            if not merged:
-                continue
             va = self.vals.get(key)
             vb = other.vals.get(key)
-            new_births[key] = merged
-            new_vals[key] = self._merge_child_ctx(
+            child = self._merge_child_ctx(
                 va if va is not None else cls(),
                 vb if vb is not None else cls(),
-                ca_eff, cb_eff,
+                self.clock, other.clock,
             )
+            if merged:
+                new_births[key] = merged
+                new_vals[key] = child
+            elif self._child_residue(child):
+                new_vals[key] = child  # dead key, live residue
 
-        # deferred horizons union by max
-        for key, dfr in other.deferred.items():
-            mine = self.deferred.setdefault(key, {})
-            for a, c in dfr.items():
-                if c > mine.get(a, 0):
-                    mine[a] = c
+        # pending removes union (keys union per identical context)
+        for tag, (ctx, rm_keys) in other.deferred.items():
+            slot = self.deferred.get(tag)
+            if slot is None:
+                self.deferred[tag] = (ctx.copy(), set(rm_keys))
+            else:
+                slot[1].update(rm_keys)
 
         self.clock.merge(other.clock)
         self.births = new_births
         self.vals = new_vals
-        # retire satisfied horizons; apply surviving ones to merged state
-        for key in list(self.deferred):
-            dfr = self.deferred[key]
-            ctx = VClock({a: c for a, c in dfr.items()})
-            birth = self.births.get(key)
-            if birth is not None:
-                for a in [a for a, c in birth.items() if c <= ctx.get(a)]:
-                    del birth[a]
-                child = self.vals.get(key)
-                if child is not None:
-                    child.reset_remove(ctx)
-                if not birth:
-                    self.births.pop(key, None)
-                    self.vals.pop(key, None)
-            self._normalize_key(key)
+        # pending removes whose cited history is now complete fire on the
+        # merged state
+        self._flush_deferred()
 
     @staticmethod
     def _surv2(xa: int, xb: int, ca_r: int, cb_r: int) -> int:
@@ -275,17 +298,6 @@ class CrdtMap:
         """Merge two child states under the MAP clocks (see merge())."""
         if self.child == b"orset":
             return self._merge_orset_ctx(va, vb, ca, cb)
-        if self.child == b"mvreg":
-            return self._merge_mvreg_ctx(va, vb, ca, cb)
-        if self.child == b"gcounter":
-            out = GCounter()
-            out.clock = self._merge_clock_ctx(va.clock, vb.clock, ca, cb)
-            return out
-        if self.child == b"pncounter":
-            out = PNCounter()
-            out.p.clock = self._merge_clock_ctx(va.p.clock, vb.p.clock, ca, cb)
-            out.n.clock = self._merge_clock_ctx(va.n.clock, vb.n.clock, ca, cb)
-            return out
         raise ValueError(f"unknown child CRDT type {self.child!r}")
 
     @classmethod
@@ -334,24 +346,6 @@ class CrdtMap:
                 del out.deferred[m]
         return out
 
-    @classmethod
-    def _merge_mvreg_ctx(cls, va: MVReg, vb: MVReg, ca: VClock, cb: VClock) -> MVReg:
-        def survivors(mine: MVReg, theirs: MVReg, their_map_clock: VClock):
-            out = []
-            for c, v in mine.vals:
-                if any(c == oc for oc, _ in theirs.vals):
-                    out.append((c.copy(), v))
-                    continue
-                dominated = any(oc.dominates(c) for oc, _ in theirs.vals)
-                if not dominated and not their_map_clock.descends(c):
-                    out.append((c.copy(), v))
-            return out
-
-        out = MVReg()
-        out.vals = survivors(va, vb, cb) + survivors(vb, va, ca)
-        out._canonicalize()
-        return out
-
     # -- reads -------------------------------------------------------------
     def get(self, key):
         return self.vals.get(key)
@@ -391,7 +385,7 @@ class CrdtMap:
         return key
 
     def to_obj(self):
-        keys = self.keys()
+        all_keys = sorted(set(self.births) | set(self.vals), key=codec.pack)
         cls = self._child_type()[0]
         return [
             self.child,
@@ -399,16 +393,17 @@ class CrdtMap:
             [
                 [
                     k,
-                    {a: c for a, c in sorted(self.births[k].items())},
+                    {
+                        a: c
+                        for a, c in sorted(self.births.get(k, {}).items())
+                    },
                     self.vals[k].to_obj() if k in self.vals else cls().to_obj(),
                 ]
-                for k in keys
+                for k in all_keys
             ],
             [
-                [k, {a: c for a, c in sorted(d.items())}]
-                for k, d in sorted(
-                    self.deferred.items(), key=lambda kv: codec.pack(kv[0])
-                )
+                [ctx.to_obj(), sorted(rm_keys, key=codec.pack)]
+                for _, (ctx, rm_keys) in sorted(self.deferred.items())
             ],
         ]
 
@@ -420,10 +415,12 @@ class CrdtMap:
         ctype = m._child_type()[0]
         for k, birth, val in entries:
             k = cls._thaw_key(k)
-            m.births[k] = {bytes(a): int(c) for a, c in birth.items()}
+            if birth:
+                m.births[k] = {bytes(a): int(c) for a, c in birth.items()}
             m.vals[k] = ctype.from_obj(val)
-        for k, d in deferred:
-            m.deferred[cls._thaw_key(k)] = {
-                bytes(a): int(c) for a, c in d.items()
-            }
+        for ctx_obj, rm_keys in deferred:
+            m._defer(
+                VClock.from_obj(ctx_obj),
+                [cls._thaw_key(k) for k in rm_keys],
+            )
         return m

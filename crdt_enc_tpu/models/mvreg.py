@@ -53,9 +53,15 @@ class MVReg:
         return self.read().derive_write(actor, value)
 
     def apply(self, op: MVRegOp) -> None:
-        # Drop pairs the op causally supersedes; keep the op unless superseded.
-        kept = [(c, v) for c, v in self.vals if not op.clock.descends(c)]
-        if not any(c.descends(op.clock) for c, _ in kept):
+        # Drop pairs the op STRICTLY supersedes; keep the op unless itself
+        # strictly superseded.  Equal-clock pairs with distinct values
+        # coexist (ordinary ctx-derived writes never produce them — each
+        # write carries a fresh dot — but a causal-Map reset can shrink
+        # two different writes onto one clock, and preferring one by
+        # serialization order would diverge; exact duplicates are deduped
+        # by _canonicalize).
+        kept = [(c, v) for c, v in self.vals if not op.clock.dominates(c)]
+        if not any(c.dominates(op.clock) for c, _ in kept):
             kept.append((op.clock.copy(), op.value))
         self.vals = kept
         self._canonicalize()

@@ -145,6 +145,18 @@ def _bind(lib) -> None:
         i32p, u64p, u64p, ctypes.c_int64,
     ]
     lib.intern_spans_native.restype = ctypes.c_int64
+    lib.map_count_rows_batch.argtypes = [
+        u8p, u64p, u64p, ctypes.c_uint64, i64p
+    ]
+    lib.map_count_rows_batch.restype = ctypes.c_int64
+    lib.map_decode_batch.argtypes = (
+        [u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+        + [u64p, u64p, i32p, i32p]
+        + [u64p, u64p, u64p, u64p, i32p, i32p]
+        + [u64p, u64p, u64p, u64p, i32p, i32p, i32p, i32p]
+        + [u64p, u64p, i32p, i32p, i32p]
+    )
+    lib.map_decode_batch.restype = ctypes.c_int64
 
 
 

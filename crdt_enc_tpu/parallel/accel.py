@@ -268,6 +268,10 @@ class TpuAccelerator(HostAccelerator):
         the per-op path."""
         if isinstance(state, (GCounter, PNCounter)):
             return self._fold_counter_payloads(state, payloads, actors_hint)
+        from ..models.crdtmap import CrdtMap
+
+        if isinstance(state, CrdtMap):
+            return self._fold_map_payloads(state, payloads, actors_hint)
         if not isinstance(state, ORSet):
             return False
         from ..ops.native_decode import decode_orset_payload_batch
@@ -340,6 +344,43 @@ class TpuAccelerator(HostAccelerator):
         self._fold_orset_columns(
             state, kind, member_idx, actor_idx, counter, members, replicas
         )
+        return True
+
+    def _fold_map_payloads(self, state, payloads: list, actors_hint=()) -> bool:
+        """CrdtMap<orset> bulk path: native four-family decode → the
+        vectorized columnar fold (ops/map_columnar.py).  Declines (per-op
+        fallback) for other child types, non-shared-dot payloads, or any
+        decode surprise."""
+        if state.child != b"orset":
+            return False
+        from ..ops.map_columnar import crdtmap_fold_host, decode_map_payload_batch
+
+        actor_set = set(actors_hint)
+        actor_set.update(state.clock.counters)
+        for birth in state.births.values():
+            actor_set.update(birth)
+        for ctx, _rm_keys in state.deferred.values():
+            actor_set.update(ctx.counters)
+        for child in state.vals.values():
+            actor_set.update(child.clock.counters)
+            for entry in child.entries.values():
+                actor_set.update(entry)
+            for dfr in child.deferred.values():
+                actor_set.update(dfr)
+        actors_sorted = sorted(actor_set)
+        with trace.span("fold.map_decode"):
+            decoded = decode_map_payload_batch(payloads, actors_sorted)
+        if decoded is None:
+            return False
+        B, A, Rm, Kk, key_objs, member_objs = decoded
+        keys = K.Vocab(key_objs)
+        members = K.Vocab(member_objs)
+        # vocab value-collision guard (1 == True etc.), as in the ORSet path
+        if len(keys) != len(key_objs) or len(members) != len(member_objs):
+            return False
+        replicas = K.Vocab(actors_sorted)
+        with trace.span("fold.map"):
+            crdtmap_fold_host(state, B, A, Rm, Kk, keys, members, replicas)
         return True
 
     def _fold_counter_payloads(self, state, payloads: list, actors_hint=()) -> bool:

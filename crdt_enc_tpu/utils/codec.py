@@ -25,6 +25,12 @@ def unpack(data: bytes):
 
 
 def _canon(obj, as_key: bool = False):
+    # scalar fast path first: the overwhelming majority of nodes are
+    # scalars/bytes and pack() sits on every hot path (seal,
+    # canonical_bytes, sort keys), so per-node isinstance chains add up
+    t = obj.__class__
+    if t is int or t is bytes or t is str or obj is None or t is bool or t is float:
+        return obj
     if isinstance(obj, dict):
         # Sort by the packed key bytes so ordering is type-stable.
         items = [(_canon(k, as_key=True), _canon(v)) for k, v in obj.items()]

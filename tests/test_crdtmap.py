@@ -24,7 +24,7 @@ from crdt_enc_tpu.backends import (
 )
 from crdt_enc_tpu.core import Core, OpenOptions, map_adapter
 from crdt_enc_tpu.models import CrdtMap, canonical_bytes
-from crdt_enc_tpu.models.mvreg import MVRegOp
+from crdt_enc_tpu.utils import codec
 from crdt_enc_tpu.models.orset import AddOp
 from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
 
@@ -91,34 +91,13 @@ def orset_child_history(script):
     return oracle, [s for s in streams.values() if s]
 
 
-def mvreg_child_history(script):
-    oracle = CrdtMap(child=b"mvreg")
-    streams = {a: [] for a in ACTORS}
-    for actor_i, kind, key_i, val in script:
-        actor, key = ACTORS[actor_i], KEYS[key_i]
-        if kind == "rm_key":
-            op = oracle.rm_ctx(key)
-            if op.ctx.is_empty():
-                continue
-        else:
-            def build(child, dot, val=val):
-                clock = child.read().clock
-                clock.apply(dot)
-                return MVRegOp(clock, val)
-
-            op = oracle.update_ctx(actor, key, build)
-        oracle.apply(op)
-        streams[actor].append(op)
-    return oracle, [s for s in streams.values() if s]
-
-
-HISTORIES = {"orset": orset_child_history, "mvreg": mvreg_child_history}
+HISTORIES = {"orset": orset_child_history}
 
 
 # ---- laws ------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("child", ["orset", "mvreg"])
+@pytest.mark.parametrize("child", ["orset"])
 @settings(max_examples=120, deadline=None)
 @given(script=map_script, data=st.data())
 def test_map_convergence_under_interleaving(child, script, data):
@@ -133,7 +112,7 @@ def test_map_convergence_under_interleaving(child, script, data):
     ) == canonical_bytes(oracle)
 
 
-@pytest.mark.parametrize("child", ["orset", "mvreg"])
+@pytest.mark.parametrize("child", ["orset"])
 @settings(max_examples=120, deadline=None)
 @given(script=map_script, data=st.data())
 def test_map_cm_cv_agreement_and_merge_laws(child, script, data):
@@ -260,24 +239,82 @@ def test_core_lifecycle_map():
     asyncio.run(go())
 
 
-def test_counter_child_reset_remove_and_merge():
-    """Map<pncounter>: removing a key resets the observed count; a
-    concurrent increment survives the remove."""
-    from crdt_enc_tpu.models.counters import POS
+def test_true_concurrency_convergence():
+    """Ops derived from DIVERGENT replica states (not a single oracle),
+    gossiped with per-actor FIFO but no causal ordering — the delivery
+    model the file-sync transport actually provides.  All replicas must
+    converge at full delivery, and the columnar bulk fold must agree.
+    This class of history caught two real design flaws the oracle-based
+    tests cannot see (suppression losing child sub-ops; child horizons
+    stranded across key incarnations)."""
+    import random
 
-    a = CrdtMap(child=b"pncounter")
-    b = CrdtMap(child=b"pncounter")
-    up1 = a.update_ctx(ACTORS[0], "hits", lambda c, d: (POS, d))
-    a.apply(up1)
-    b.apply(up1)
-    assert a.get("hits").read() == 1
-    rm = a.rm_ctx("hits")
-    upb = b.update_ctx(ACTORS[1], "hits", lambda c, d: (POS, d))
-    a.apply(rm)
-    b.apply(upb)
-    assert not a.contains("hits")
-    a.merge(b)
-    b.apply(rm)
-    assert canonical_bytes(a) == canonical_bytes(b)
-    # the concurrent increment survives; the observed one was removed
-    assert a.contains("hits") and a.get("hits").read() == 1
+    from crdt_enc_tpu.parallel.accel import TpuAccelerator
+
+    accel = TpuAccelerator(min_device_batch=1)
+    proto = CrdtMap(child=b"orset")
+    rng = random.Random(5)
+    for trial in range(150):
+        n_rep = 3
+        reps = [CrdtMap(child=b"orset") for _ in range(n_rep)]
+        logs = {a: [] for a in ACTORS[:n_rep]}
+        delivered = [
+            dict((a, 0) for a in ACTORS[:n_rep]) for _ in range(n_rep)
+        ]
+        for _ in range(rng.randrange(4, 22)):
+            i = rng.randrange(n_rep)
+            actor = ACTORS[i]
+            s = reps[i]
+            kind = rng.choice(
+                ["add", "rm_member", "rm_key", "deliver", "deliver"]
+            )
+            if kind == "deliver":
+                src = ACTORS[rng.randrange(n_rep)]
+                pos = delivered[i][src]
+                if pos < len(logs[src]):
+                    reps[i].apply(logs[src][pos])
+                    delivered[i][src] = pos + 1
+                continue
+            key = rng.choice(KEYS)
+            if kind == "add":
+                op = s.update_ctx(
+                    actor, key,
+                    lambda c, d: AddOp(rng.choice(MEMBERS), d),
+                )
+            elif kind == "rm_member":
+                child = s.get(key)
+                ms = (
+                    sorted(child.entries, key=codec.pack) if child else []
+                )
+                if not ms:
+                    continue
+                op = s.update_ctx(
+                    actor, key,
+                    lambda c, d, m=rng.choice(ms): c.rm_ctx(m),
+                )
+            else:
+                op = s.rm_ctx(key)
+                if op.ctx.is_empty():
+                    continue
+            s.apply(op)
+            logs[actor].append(op)
+            delivered[i][actor] = len(logs[actor])
+        finals = []
+        for i in range(n_rep):
+            pending = {a: delivered[i][a] for a in logs}
+            while any(pending[a] < len(logs[a]) for a in logs):
+                a = rng.choice(
+                    [a for a in logs if pending[a] < len(logs[a])]
+                )
+                reps[i].apply(logs[a][pending[a]])
+                pending[a] += 1
+            finals.append(canonical_bytes(reps[i]))
+        assert len(set(finals)) == 1, (trial, "replicas diverged")
+        payloads = [
+            codec.pack([proto.op_to_obj(op)])
+            for a in logs
+            for op in logs[a]
+        ]
+        bulk = CrdtMap(child=b"orset")
+        ok = accel.fold_payloads(bulk, payloads, actors_hint=ACTORS[:n_rep])
+        assert ok and canonical_bytes(bulk) == finals[0], (trial, "bulk")

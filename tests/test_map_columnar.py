@@ -1,0 +1,157 @@
+"""Columnar CrdtMap<orset> bulk fold ≡ per-op host fold.
+
+The referee is the host model: random causally consistent op histories
+(the same generator the map law tests use) sealed into payloads, decoded
+natively, folded columnar — canonical bytes must match the per-op apply,
+batch-into-empty and batch-into-populated-state alike."""
+
+import asyncio
+import random
+import uuid
+
+from crdt_enc_tpu.backends import (
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import Core, OpenOptions, map_adapter
+from crdt_enc_tpu.models import CrdtMap, canonical_bytes
+from crdt_enc_tpu.models.orset import AddOp
+from crdt_enc_tpu.parallel.accel import TpuAccelerator
+from crdt_enc_tpu.utils import codec
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+from tests.test_crdtmap import orset_child_history
+
+ACTORS = [uuid.UUID(int=i + 1).bytes for i in range(4)]
+
+
+def _payloads_from_streams(m, streams, per_file=3):
+    """Seal per-actor op streams into op-file payloads, one actor's files
+    after another (per-actor order is the only ordering the fold's
+    contract requires; it is order-free across actors)."""
+    files = []
+    for s in streams:
+        for i in range(0, len(s), per_file):
+            files.append([m.op_to_obj(op) for op in s[i : i + per_file]])
+    return [codec.pack(f) for f in files]
+
+
+def test_columnar_fold_matches_host_fuzz():
+    rng = random.Random(7)
+    proto = CrdtMap(child=b"orset")
+    for trial in range(400):
+        n = rng.randrange(0, 30)
+        script = [
+            (rng.randrange(4),
+             rng.choice(["add", "rm_member", "rm_key", "write"]),
+             rng.randrange(3), rng.randrange(3))
+            for _ in range(n)
+        ]
+        oracle, streams = orset_child_history(script)
+        payloads = _payloads_from_streams(proto, streams)
+        accel = TpuAccelerator(min_device_batch=1)
+        folded = CrdtMap(child=b"orset")
+        ok = accel.fold_payloads(folded, payloads, actors_hint=ACTORS)
+        assert ok, f"trial {trial}: accelerator declined"
+        assert canonical_bytes(folded) == canonical_bytes(oracle), (
+            f"trial {trial} diverged: {script}"
+        )
+
+
+def test_columnar_fold_into_populated_state():
+    """Fold the second half of a history into the state built per-op from
+    the first half — cursor-style incremental ingest."""
+    rng = random.Random(11)
+    proto = CrdtMap(child=b"orset")
+    for trial in range(200):
+        n = rng.randrange(4, 30)
+        script = [
+            (rng.randrange(4),
+             rng.choice(["add", "rm_member", "rm_key", "write"]),
+             rng.randrange(3), rng.randrange(3))
+            for _ in range(n)
+        ]
+        oracle, streams = orset_child_history(script)
+        # split each actor stream: first half applied per-op, second bulk
+        base = CrdtMap(child=b"orset")
+        tails = []
+        for s in streams:
+            half = len(s) // 2
+            for op in s[:half]:
+                base.apply(op)
+            tails.append(s[half:])
+        payloads = _payloads_from_streams(proto, tails)
+        accel = TpuAccelerator(min_device_batch=1)
+        ok = accel.fold_payloads(base, payloads, actors_hint=ACTORS)
+        assert ok, f"trial {trial}: declined"
+        assert canonical_bytes(base) == canonical_bytes(oracle), (
+            f"trial {trial} diverged: {script}"
+        )
+
+
+def test_columnar_declines_foreign_dot():
+    """A child add whose dot differs from the map dot breaks the
+    shared-dot discipline the fold relies on — must decline, per-op path
+    handles it."""
+    from crdt_enc_tpu.models.vclock import Dot
+
+    m = CrdtMap(child=b"orset")
+    up = m.update_ctx(ACTORS[0], "k", lambda c, d: AddOp(1, Dot(ACTORS[1], 1)))
+    payload = codec.pack([m.op_to_obj(up)])
+    accel = TpuAccelerator(min_device_batch=1)
+    state = CrdtMap(child=b"orset")
+    assert accel.fold_payloads(state, [payload], actors_hint=ACTORS) is False
+    assert canonical_bytes(state) == canonical_bytes(CrdtMap(child=b"orset"))
+
+
+def test_map_bulk_ingest_through_core():
+    """End to end: a map replica's history ingests through the bulk path
+    and matches a per-op reference reader."""
+    import crdt_enc_tpu.core.core as core_mod
+
+    async def go():
+        def opts(remote, accel=None):
+            kw = {"accelerator": accel} if accel is not None else {}
+            return OpenOptions(
+                storage=MemoryStorage(remote),
+                cryptor=IdentityCryptor(),
+                key_cryptor=PlainKeyCryptor(),
+                adapter=map_adapter(b"orset"),
+                supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+                current_data_version=DEFAULT_DATA_VERSION_1,
+                create=True,
+                **kw,
+            )
+
+        remote = MemoryRemote()
+        w = await Core.open(opts(remote))
+        for i in range(30):
+            key = f"k{i % 5}"
+            if i % 11 == 10:
+                op = w.with_state(lambda s, key=key: s.rm_ctx(key))
+                if not op.ctx.is_empty():
+                    await w.apply_ops([op])
+            else:
+                await w.update(
+                    lambda s, key=key, i=i: s.update_ctx(
+                        w.actor_id, key, lambda c, d: AddOp(i % 7, d)
+                    )
+                )
+        r = await Core.open(opts(remote, TpuAccelerator(min_device_batch=1)))
+        await r.read_remote()
+        ref = await Core.open(opts(remote))
+        await ref.read_remote()
+        assert canonical_bytes(r.with_state(lambda s: s)) == canonical_bytes(
+            ref.with_state(lambda s: s)
+        )
+        # and the compaction snapshot round-trips
+        await r.compact()
+        f = await Core.open(opts(remote))
+        await f.read_remote()
+        assert canonical_bytes(f.with_state(lambda s: s)) == canonical_bytes(
+            r.with_state(lambda s: s)
+        )
+
+    asyncio.run(go())
