@@ -127,6 +127,10 @@ class SeqList:
 
     def delete_ctx(self, index: int) -> DelOp:
         order = self._order()
+        if not 0 <= index < len(order):
+            # no negative indexing: a caller's off-by-one would silently
+            # tombstone the LAST element, irreversibly, on every replica
+            raise IndexError(f"delete index {index} out of range")
         path, actor, seq = order[index]
         return DelOp(path, actor, seq)
 

@@ -34,7 +34,7 @@ from .columnar import KIND_ADD, KIND_RM
     jax.jit,
     static_argnames=(
         "num_members", "num_replicas", "sort_segments", "impl",
-        "small_counters",
+        "small_counters", "retire_rm",
     ),
 )
 def orset_fold(
@@ -51,8 +51,16 @@ def orset_fold(
     sort_segments: bool = False,
     impl: str = "fused",
     small_counters: bool = False,
+    retire_rm: bool = True,
 ):
     """Fold an op batch into normalized ORSet planes.
+
+    ``retire_rm=False`` keeps remove horizons un-retired (no
+    ``rm > clock`` zeroing): required when the planes are a PARTIAL
+    reduction to be combined with a pre-existing state later — a horizon
+    retired against the batch-local clock would lose its kill-effect on
+    state entries it never met (the streaming session's combine retires
+    once, against the true merged clock).
 
     Returns ``(clock, add, rm)`` in canonical/normalized form: entries
     zeroed where ``add ≤ rm``, horizons zeroed where ``rm ≤ clock``.
@@ -136,7 +144,8 @@ def orset_fold(
     # Normalize: a horizon kills every dot it covers; a horizon the clock
     # caught up with has fully applied.
     add = jnp.where(add > rm, add, 0)
-    rm = jnp.where(rm > clock[None, :], rm, 0)
+    if retire_rm:
+        rm = jnp.where(rm > clock[None, :], rm, 0)
     return clock, add, rm
 
 

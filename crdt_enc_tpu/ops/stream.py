@@ -29,17 +29,19 @@ from .orset import orset_fold
 
 @partial(
     jax.jit,
-    static_argnames=("num_members", "num_replicas", "impl", "small_counters"),
+    static_argnames=(
+        "num_members", "num_replicas", "impl", "small_counters", "retire_rm",
+    ),
     donate_argnums=(0, 1, 2),
 )
 def _fold_donated(
     clock, add, rm, kind, member, actor, counter,
-    *, num_members, num_replicas, impl, small_counters,
+    *, num_members, num_replicas, impl, small_counters, retire_rm=True,
 ):
     return orset_fold(
         clock, add, rm, kind, member, actor, counter,
         num_members=num_members, num_replicas=num_replicas,
-        impl=impl, small_counters=small_counters,
+        impl=impl, small_counters=small_counters, retire_rm=retire_rm,
     )
 
 

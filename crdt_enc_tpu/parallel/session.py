@@ -209,11 +209,13 @@ class OrsetFoldSession:
             # steps (the compile cache then amortizes across runs)
             self._d_E = _bucket(max(len(self.members), 1) * 4)
             # the device planes seed from ZERO, not from the state: the
-            # ops-only fold is itself a valid ORSet state (stale replays
-            # and deferred removes resolve through the CvRDT merge with
-            # the live state at finish), and never reading the state here
-            # keeps this thread-safe against concurrent applies — this
-            # code runs off the event loop (core drain_one → to_thread)
+            # streamed fold is a pure reduction of the op batch, combined
+            # into the live state at finish with op-APPLY semantics
+            # (apply_batch_planes_host — NOT the CvRDT merge, whose
+            # survivor rule would misread the batch clock as state
+            # history), and never reading the state here keeps this
+            # thread-safe against concurrent applies — this code runs off
+            # the event loop (core drain_one → to_thread)
             import jax
 
             self._d_planes = (
@@ -330,10 +332,14 @@ class OrsetFoldSession:
             rows = min(DEVICE_CHUNK_ROWS, _bucket(len(kind)))
             clock, add, rm = self._d_planes
             for chunk in iter_orset_chunks(kind, member, actor, counter, rows, self.R):
+                # retire_rm=False: a horizon retired against the
+                # batch-local clock would lose its kill-effect on
+                # pre-existing state entries; finish() retires once
+                # against the true merged clock
                 clock, add, rm = _fold_donated(
                     clock, add, rm, *chunk,
                     num_members=self._d_E, num_replicas=self.R,
-                    impl="fused", small_counters=False,
+                    impl="fused", small_counters=False, retire_rm=False,
                 )
             # no block: jax dispatch is async — the next chunk's decrypt
             # and decode overlap the device work
@@ -345,11 +351,10 @@ class OrsetFoldSession:
 
         Concurrency-correct by construction: the state is re-read HERE, in
         one sync section, so applies or state merges that landed while
-        chunks were in flight are honored — HOST_REDUCE re-evaluates the
-        stale mask against the current clock inside
-        ``orset_apply_batch_planes``; DEVICE_STREAM combines through the
-        CvRDT ``orset_merge`` (the device planes are a valid state
-        descended from the promotion snapshot, so merge semantics apply)."""
+        chunks were in flight are honored — both modes re-evaluate the
+        stale mask against the current clock inside the op-apply combine
+        (``apply_batch_planes_host``; batch planes are reductions of OPS,
+        never CvRDT states — see the device_finish comment)."""
         assert not self._finished, "session already finished"
         self._finished = True
         state = self.state
@@ -389,17 +394,20 @@ class OrsetFoldSession:
                 )
         else:
             with trace.span("session.device_finish"):
-                d_clock, d_add, d_rm = (np.asarray(x) for x in self._d_planes)
+                # op-APPLY semantics, exactly as HOST_REDUCE: the streamed
+                # planes are a fold of OPS from a zero clock, NOT a valid
+                # CvRDT state — their clock (per-actor add maxima) covers
+                # every older dot of those actors, so the CvRDT merge's
+                # survivor rule would delete pre-existing entries the
+                # batch never touched (confirmed data loss; regression in
+                # tests/test_fold_session.py)
+                _, d_add, d_rm = (np.asarray(x) for x in self._d_planes)
                 E_pad = max(self._d_E, _bucket(max(E, 1)))
                 clock0, add0, rm0 = self._state_planes(E_pad)
                 d_add = self._pad_batch(d_add, E_pad, R_final)
                 d_rm = self._pad_batch(d_rm, E_pad, R_final)
-                d_clock = self._pad_clock(d_clock, R_final)
-                clock, add, rm = (
-                    np.asarray(x)
-                    for x in K.orset_merge(
-                        clock0, add0, rm0, d_clock, d_add, d_rm
-                    )
+                clock, add, rm = apply_batch_planes_host(
+                    clock0, add0, rm0, d_add, d_rm
                 )
         with trace.span("session.writeback"):
             folded = K.orset_planes_to_state(

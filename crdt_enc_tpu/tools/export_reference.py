@@ -177,6 +177,8 @@ async def export_reference_log(
     actors = await src.storage.list_op_actors()
     if not actors:
         raise ReferenceFormatError("source remote has no op logs to export")
+    from .fsck import _list_op_versions
+
     for actor in sorted(actors):
         files = await src.storage.load_ops([(actor, 1)])
         if not files:
@@ -184,6 +186,17 @@ async def export_reference_log(
                 f"actor {actor.hex()}'s log does not start at version 1 "
                 "(GC'd prefix?): the reference's dense from-0 scan would "
                 "see none of it — use state mode"
+            )
+        # a mid-log hole with files beyond it would silently truncate the
+        # export (load_ops scans densely and stops at the hole) — refuse,
+        # exactly as the importer refuses a gapped source
+        versions = await _list_op_versions(src.storage, actor)
+        if versions is not None and len(versions) > len(files):
+            raise ReferenceFormatError(
+                f"actor {actor.hex()}'s log has a gap at version "
+                f"{files[-1][1] + 1} with {len(versions) - len(files)} "
+                "file(s) stranded beyond it — refusing a partial export "
+                "(run tools.fsck for the damage report)"
             )
         stats.actors += 1
         for _, version, raw in files:

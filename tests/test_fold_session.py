@@ -116,9 +116,8 @@ def test_session_modes_match_host(force_mode, chunk_files):
 @pytest.mark.parametrize("force_mode", ["host_reduce", "device_stream"])
 def test_session_into_existing_state_matches_host(force_mode):
     """Folding a tail into a state that already holds a prefix (the
-    snapshot-resume shape) — including removes whose targets live only in
-    the prefix state, which the device path resolves via the CvRDT merge
-    of its zero-seeded ops-only planes."""
+    snapshot-resume shape) — including removes whose targets live only
+    in the prefix state."""
     host, ops = _history(300, 17, seed=5, rm_every=5)
     prefix = ORSet()
     for op in ops[:120]:
@@ -425,3 +424,29 @@ def test_scan_error_propagates_not_hangs(tmp_path):
         await asyncio.wait_for(go(), timeout=30)
 
     run(with_timeout())
+
+
+@pytest.mark.parametrize("force_mode", ["host_reduce", "device_stream"])
+def test_session_keeps_untouched_preexisting_members(force_mode):
+    """Regression (confirmed data loss): a pre-existing member whose dot
+    is OLDER than the batch's dots for the same actor, and which the
+    batch never mentions, must survive the session.  The zero-seeded
+    device planes' per-actor add maxima cover such dots, so combining
+    them with the CvRDT merge (instead of op-apply semantics) deleted
+    the member; `_history`'s small cycling member pool masked it because
+    every prefix member was re-added in the tail."""
+    actor = ACTORS[0]
+    base = ORSet()
+    base.apply(base.add_ctx(actor, "old-untouched"))
+    host = ORSet.from_obj(base.to_obj())
+    ops = []
+    for i in range(40):  # later dots by the SAME actor, other members
+        op = host.add_ctx(actor, f"new-{i}")
+        host.apply(op)
+        ops.append(op)
+    folded = _run_session(
+        ops, chunk_files=2, force_mode=force_mode,
+        state=ORSet.from_obj(base.to_obj()),
+    )
+    assert folded.contains("old-untouched"), force_mode
+    assert canonical_bytes(folded) == canonical_bytes(host), force_mode

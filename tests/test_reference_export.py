@@ -201,3 +201,31 @@ def test_export_cli(tmp_path, capsys):
         assert sorted(dest.with_state(lambda s: s.read().values)) == [2, 3]
 
     run(check())
+
+
+def test_log_export_refuses_gapped_source(tmp_path):
+    """A mid-log hole with files stranded beyond it must refuse the
+    export (load_ops' dense scan would silently truncate), mirroring the
+    importer's gap refusal."""
+    import os as _os
+
+    src = _populate(tmp_path)
+    key = secrets.token_bytes(32)
+
+    async def go():
+        # punch a hole in one actor's log: keep v1, drop v2... need a log
+        # with >1 file — write more ops from replica a first
+        for i in range(3):
+            await src.update(lambda s, i=i: s.write_ctx(src.actor_id, 10 + i))
+        ops_dir = (
+            tmp_path / "shared" / "remote" / "ops" / src.actor_id.hex()
+        )
+        versions = sorted(int(n) for n in _os.listdir(ops_dir))
+        assert len(versions) >= 3
+        _os.remove(ops_dir / str(versions[1]))
+        with pytest.raises(ReferenceFormatError, match="stranded"):
+            await export_reference_log(
+                src, tmp_path / "ref-remote", key, APP_DATA_VERSION
+            )
+
+    run(go())
