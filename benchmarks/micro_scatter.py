@@ -1,0 +1,223 @@
+"""Micro-benchmarks characterizing the north-star fold's component costs
+on the real chip, to size the Pallas fold kernel (round-3 item 1).
+
+Measures, each as a chained-scan marginal (tunnel latency cancelled):
+  1. fused i16 scatter alone (the suspected serialization wall)
+  2. elementwise plane pass (read 2 planes, write 2 planes)
+  3. jax.lax.sort of the op batch by segment key
+  4. one-hot matmul segment-max prototype (scatter -> MXU reformulation)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from bench import gen_columns, force_completion
+
+N = int(os.environ.get("MB_OPS", 1_000_000))
+R = int(os.environ.get("MB_REPLICAS", 10_000))
+E = int(os.environ.get("MB_MEMBERS", 4096))
+CHAIN = int(os.environ.get("MB_CHAIN", 20))
+ITERS = 3
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def marginal(make_chain):
+    def timed(fn):
+        out = fn()
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            force_completion(out)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t1 = timed(make_chain(1))
+    tk = timed(make_chain(1 + CHAIN))
+    return (tk - t1) / CHAIN
+
+
+def main():
+    which = set((os.environ.get("MB_WHICH") or
+                 "scatter,elem,sort,onehot,i8,f32").split(","))
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); N={N} R={R} E={E} CHAIN={CHAIN}")
+    kind, member, actor, counter = gen_columns(N, R, E)
+    pad = actor >= R
+    actor_ix = np.minimum(actor, R - 1)
+    seg = (member.astype(np.int64) * R + actor_ix).astype(np.int32)
+    is_rm = (kind == 1) & ~pad
+    seg2 = np.where(is_rm, seg + E * R, seg).astype(np.int32)
+    vals = np.where(~pad, counter, 0).astype(np.int16)
+
+    seg2_d = jax.device_put(seg2, dev)
+    vals_d = jax.device_put(vals, dev)
+    c0 = jax.device_put(np.zeros(R, np.int32), dev)
+    a0 = jax.device_put(np.zeros((E, R), np.int32), dev)
+    r0 = jax.device_put(np.zeros((E, R), np.int32), dev)
+
+    # 1. fused i16 scatter alone, carry-anchored (offset added to values so
+    # the scatter depends on the carry; values stay positive)
+    def mk_scatter(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                z = jnp.zeros((2 * E * R,), jnp.int16)
+                both = z.at[seg2_d].max(vals_d + carry.astype(jnp.int16), mode="drop")
+                return both.max().astype(jnp.int32) % 2, ()
+            c, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+            return c
+        return run
+
+    if "scatter" in which:
+        t = marginal(mk_scatter)
+        log(f"scatter i16 alone: {t*1e3:.2f} ms  ({N/t/1e6:.0f}M rows/s)")
+
+    # 2. elementwise plane pass: read add0/rm0 + new planes, write both
+    def mk_elem(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                a, r = carry
+                an = jnp.maximum(a0, a + 1)
+                rn = jnp.maximum(r0, r + 1)
+                an = jnp.where(an > rn, an, 0)
+                return (an, rn), ()
+            carry, _ = jax.lax.scan(body, (a0, r0), None, length=n)
+            return carry
+        return run
+
+    if "elem" in which:
+        t = marginal(mk_elem)
+        log(f"elementwise 2-plane pass: {t*1e3:.2f} ms")
+
+    # 3. sort 1M rows by (key, counter)
+    key_d = jax.device_put(seg2, dev)
+    cnt_d = jax.device_put(counter, dev)
+
+    def mk_sort(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                k, c = jax.lax.sort((key_d + carry, cnt_d), num_keys=2)
+                return k[0] % 2, ()
+            c, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+            return c
+        return run
+
+    if "sort" in which:
+        t = marginal(mk_sort)
+        log(f"sort 1M x (key,counter): {t*1e3:.2f} ms")
+
+    # 4. one-hot matmul prototype: per member-tile segment-max as
+    #    A^T @ B over padded per-tile row chunks.  Uses sorted+deduped rows
+    #    (dedup zeroes non-run-max), f32 MXU.  Prototype only measures the
+    #    matmul+onehot cost on pre-binned data (binning cost = sort above).
+    TILE_E = 8
+    T = E // TILE_E
+    CMAX = int(os.environ.get("MB_CMAX", 4096))  # rows per tile, padded
+    # host-side binning for the prototype
+    order = np.argsort(seg, kind="stable")
+    smem, sact, scnt = member[order], actor_ix[order], counter[order].astype(np.int32)
+    tile = smem // TILE_E
+    rows_m = np.zeros((T, CMAX), np.int32)
+    rows_a = np.zeros((T, CMAX), np.int32)
+    rows_v = np.zeros((T, CMAX), np.float32)
+    for t_ix in range(T):
+        lo, hi = np.searchsorted(tile, [t_ix, t_ix + 1])
+        n_t = min(hi - lo, CMAX)
+        rows_m[t_ix, :n_t] = smem[lo:lo + n_t] % TILE_E
+        rows_a[t_ix, :n_t] = sact[lo:lo + n_t]
+        rows_v[t_ix, :n_t] = scnt[lo:lo + n_t]
+    H = (R + 127) // 128
+    rm_d = jax.device_put(rows_m, dev)
+    ra_d = jax.device_put(rows_a, dev)
+    rv_d = jax.device_put(rows_v, dev)
+
+    @jax.jit
+    def onehot_tile(m, a, v, bump):
+        # A: (C, TILE_E*H) val * onehot(m*H + a_hi); B: (C, 128) onehot(a_lo)
+        a_hi, a_lo = a // 128, a % 128
+        mh = m * H + a_hi
+        A = (mh[:, None] == jnp.arange(TILE_E * H)[None, :]) * (v + bump)[:, None]
+        B = (a_lo[:, None] == jnp.arange(128)[None, :]).astype(jnp.float32)
+        acc = A.T @ B  # (TILE_E*H, 128)
+        return acc.reshape(TILE_E, H * 128)[:, :R]
+
+    def mk_onehot(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                out = jax.lax.map(
+                    lambda t: onehot_tile(rm_d[t], ra_d[t], rv_d[t], carry),
+                    jnp.arange(T), batch_size=64,
+                )
+                return out.max() % 2, ()
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+            return c
+        return run
+
+    if "onehot" in which:
+        t = marginal(mk_onehot)
+        log(f"one-hot matmul f32 (T={T}, CMAX={CMAX}): {t*1e3:.2f} ms")
+
+    # 5. int8 matmul probe: does lax.dot_general int8xint8->int32 compile+run fast?
+    ai8 = jax.device_put(np.random.randint(0, 127, (4096, 4096), np.int8), dev)
+    bi8 = jax.device_put(np.random.randint(0, 127, (4096, 4096), np.int8), dev)
+
+    def mk_i8(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                o = jax.lax.dot_general(
+                    ai8, bi8, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                ) + carry
+                return o[0, 0], ()
+            c, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+            return c
+        return run
+
+    if "i8" in which:
+        try:
+            t = marginal(mk_i8)
+            gf = 2 * 4096**3 / t / 1e12
+            log(f"int8 4096^3 matmul: {t*1e3:.2f} ms ({gf:.0f} Tops)")
+        except Exception as e:
+            log(f"int8 matmul failed: {e}")
+
+    # 6. f32 4096^3 matmul for reference
+    af = jax.device_put(np.random.rand(4096, 4096).astype(np.float32), dev)
+
+    def mk_f32(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                o = af @ (af + carry)
+                return o[0, 0], ()
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+            return c
+        return run
+
+    if "f32" in which:
+        t = marginal(mk_f32)
+        log(f"f32 4096^3 matmul: {t*1e3:.2f} ms ({2*4096**3/t/1e12:.0f} TFLOPs)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,305 @@
+"""Pallas TPU kernel: the ORSet fold with the scatter reformulated as
+sorted one-hot matmuls on the MXU — the round-3 north-star attack.
+
+The dense fold (``ops/orset.py orset_fold``) spends its wall in XLA's
+scatter-max: random (member, actor) updates serialize at ~9ns/row
+(measured: 10.3ms of the 17.1ms fused-i16 fold for 1M rows, against a
+~1.2ms bandwidth roofline for the planes it touches).  TPUs have no fast
+random scatter — but they have a fast *sort* (1M rows in ~1.9ms,
+measured) and a fast *matmul*.  So this kernel restructures the scatter
+as dense linear algebra, the idiomatic TPU answer (the same move that
+turns embedding lookups into MXU work):
+
+1. **Sort** op rows by a tile-major segment key
+   ``(member-tile, plane, member%8, actor)`` with the gated counter as
+   a secondary sort key (one XLA bitonic sort, 2 operands).
+2. **Dedup**: after the sort the last row of every key-run holds that
+   segment's max value; every other row's value is zeroed.  Each
+   (member, actor) cell now receives AT MOST ONE nonzero value, so a
+   *sum* equals the segment *max* — and a sum of one-hot rows is a
+   matmul.
+3. **Bin** purely by index arithmetic: per-tile [start, mid, end) row
+   ranges from one searchsorted over the sorted keys.  No gather, no
+   per-tile padded copy (a round-2 prototype's gather cost more than
+   the scatter it replaced) — the kernel reads the sorted arrays in
+   place at SUB-aligned offsets and masks boundary rows by position; a
+   straddling chunk is visited by both neighbouring tiles, each keeping
+   only its own rows.
+4. **Pallas kernel**, grid over member tiles: each SUB-row chunk
+   becomes transposed one-hot matrices contracted on the MXU —
+   ``A_T (8H, SUB) = onehot(member%8 · H + actor//128)``,
+   ``B (128, SUB) = onehot(actor%128) · limb(value)`` — accumulating
+   the tile's ``(8, R)`` add/rm planes in VMEM, one HBM write per tile.
+   Values split into two 7-bit limbs so bf16 MXU passes are exact
+   (limbs < 128 ≤ bf16's 8-bit mantissa); requires counters < 2^14
+   (``MAX_COUNTER``), which the routing layer checks.
+5. The normalize tail (clock advance, ``add>rm`` masking, horizon
+   retirement) is the same elementwise XLA pass as ``orset_fold`` —
+   bandwidth-bound, fused by XLA.
+
+Staleness (the replay gate against the incoming clock) is applied to the
+sorted *values*, not the keys: within a (member, actor, plane) run
+staleness is monotone in the counter, so the run-max of gated values is
+the max live counter — and the sort/bin/matmul structure stays
+independent of the carried clock, which keeps chained benchmark folds
+honest (no degenerate cheap iterations at the clock fixpoint).
+
+Byte-equality with ``orset_fold`` (and therefore with the host
+reference) is pinned by tests/test_pallas_fold.py; bench.py runs this
+as the ``pallas_bf16`` variant of the north-star config.
+
+Reference analogue: the per-op hot loop at
+/root/reference/crdt-enc/src/lib.rs:533-539.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .columnar import KIND_ADD, KIND_RM
+
+TILE_E = 8  # members per tile (int32 sublane tile)
+LANE = 128
+SUB = 512  # rows per in-kernel matmul chunk
+
+# 7-bit limb split keeps bf16 one-hot matmuls exact; counters must fit.
+MAX_COUNTER = 1 << 14
+# Sort + window working-set bound; callers chunk bigger batches.
+MAX_ROWS = 1 << 22
+
+
+def _fold_tile_kernel(
+    starts_ref, mids_ref, ends_ref,  # scalar prefetch: (T,) row ranges
+    klo_ref, khi_ref, vlo_ref, vhi_ref,  # (1, BLK) windows of sorted rows
+    out_add_ref, out_rm_ref,  # (1, 8H, 128) int32
+    *, H: int, R: int, BLK: int, dot_dtype,
+):
+    t = pl.program_id(0)
+    start, mid, end = starts_ref[t], mids_ref[t], ends_ref[t]
+    eightH = TILE_E * H
+    base = t * (2 * TILE_E * R)  # tile's key origin
+    w0 = (start // BLK) * BLK  # absolute row index of klo/vlo window start
+
+    out_add_ref[...] = jnp.zeros(out_add_ref.shape, jnp.int32)
+    out_rm_ref[...] = jnp.zeros(out_rm_ref.shape, jnp.int32)
+
+    # "rows along lanes" orientation throughout: keys/values load as
+    # (1, SUB) lane vectors and the one-hot matrices are built directly
+    # transposed — no sublane/lane relayouts anywhere in the kernel
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (eightH, SUB), 0)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, SUB), 0)
+    pos_iota = jax.lax.broadcasted_iota(jnp.int32, (1, SUB), 1)
+
+    acc_t = jnp.int32 if dot_dtype == jnp.int8 else jnp.float32
+    dims = (((1,), (1,)), ((), ()))  # contract the SUB axis of both
+
+    def chunk(j, lo, hi, plane_base):
+        """Rows [j·SUB, (j+1)·SUB) of the sorted batch, masked to this
+        tile's [lo, hi) range: transposed one-hots → limb matmuls →
+        an (8H, 128) partial plane.  A chunk never straddles the two
+        windows (SUB | BLK), so one select picks its window."""
+        off = pl.multiple_of(j * SUB, SUB)
+        local = off - w0
+        in_hi = local >= BLK
+        local = pl.multiple_of(jnp.where(in_hi, local - BLK, local), SUB)
+
+        def load(ref_lo, ref_hi):
+            return jax.lax.cond(
+                in_hi,
+                lambda: ref_hi[0, pl.ds(local, SUB)],
+                lambda: ref_lo[0, pl.ds(local, SUB)],
+            ).reshape(1, SUB)
+
+        k = load(klo_ref, khi_ref)
+        v = load(vlo_ref, vhi_ref)
+        pos = pos_iota + off
+        ok = (pos >= lo) & (pos < hi)
+        rel = k - (base + plane_base)  # = m_local*R + actor for this plane
+        m_local = rel // R
+        a = rel - m_local * R
+        col = jnp.where(ok, m_local * H + (a // LANE), -1)
+        a_lo = jnp.where(ok, a % LANE, -1)
+        A_T = (col == col_iota).astype(dot_dtype)  # (8H, SUB) 0/1
+        hot = a_lo == lane_iota  # (128, SUB)
+        v_ok = jnp.where(ok, v, 0)
+        B_lo = hot * (v & 127).astype(dot_dtype)
+        p_lo = jax.lax.dot_general(A_T, B_lo, dims, preferred_element_type=acc_t)
+        # the hi limb is zero for values < 128 — common for dot counters —
+        # so its matmul runs only when some row in the chunk needs it
+        def with_hi(_):
+            p_hi = jax.lax.dot_general(
+                A_T, hot * (v >> 7).astype(dot_dtype), dims,
+                preferred_element_type=acc_t,
+            )
+            return (p_hi.astype(jnp.int32) << 7) + p_lo.astype(jnp.int32)
+
+        return jax.lax.cond(
+            jnp.max(v_ok) >= 128, with_hi,
+            lambda _: p_lo.astype(jnp.int32), None,
+        )
+
+    def add_body(j, _):
+        out_add_ref[0] += chunk(j, start, mid, 0)
+        return 0
+
+    def rm_body(j, _):
+        out_rm_ref[0] += chunk(j, mid, end, TILE_E * R)
+        return 0
+
+    jax.lax.fori_loop(start // SUB, pl.cdiv(mid, SUB), add_body, 0)
+    jax.lax.fori_loop(mid // SUB, pl.cdiv(end, SUB), rm_body, 0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_members", "num_replicas", "tile_cap", "retire_rm",
+                     "dot_impl", "interpret"),
+)
+def orset_fold_pallas(
+    clock0: jax.Array,  # (R,) int32
+    add0: jax.Array,  # (E, R) int32
+    rm0: jax.Array,
+    kind: jax.Array,  # (N,) int8
+    member: jax.Array,  # (N,) int32
+    actor: jax.Array,  # (N,) int32  (== num_replicas ⇒ padding row)
+    counter: jax.Array,  # (N,) int32  (all < 2^14 — caller asserts)
+    *,
+    num_members: int,
+    num_replicas: int,
+    tile_cap: int = 1 << 14,  # ≥ max op rows in any 8-member tile (fold_cap)
+    retire_rm: bool = True,
+    dot_impl: str = "bf16",  # "bf16" (always exact ≤ 2^14); "int8" reserved
+    interpret: bool = False,
+):
+    """Drop-in replacement for ``orset_fold`` (same contract, same
+    normalized output) with the scatter phase on the MXU.  Handles any
+    member-tile skew (loop bounds come from the sorted ranges, not a
+    padded per-tile capacity); batches beyond ``MAX_ROWS`` must be
+    chunked by the caller (the sorted columns are held in VMEM whole)."""
+    E, R = num_members, num_replicas
+    Ep = -(-E // TILE_E) * TILE_E
+    T = Ep // TILE_E
+    H = -(-R // LANE)
+    N = kind.shape[0]
+    if N > MAX_ROWS:
+        raise ValueError(
+            f"batch of {N} rows exceeds MAX_ROWS={MAX_ROWS}; chunk it"
+        )
+
+    pad = actor >= R
+    actor_ix = jnp.minimum(actor, R - 1)
+    is_add = (kind == KIND_ADD) & ~pad
+    is_rm = (kind == KIND_RM) & ~pad
+    seen = counter <= clock0[actor_ix]
+    live_add = is_add & ~seen
+
+    tile = member // TILE_E
+    m_local = member - tile * TILE_E
+    plane = is_rm.astype(jnp.int32)
+    sentinel = T * (2 * TILE_E * R)
+    key = jnp.where(
+        is_add | is_rm,
+        (tile * 2 + plane) * (TILE_E * R) + m_local * R + actor_ix,
+        sentinel,
+    )
+    gval = jnp.where(live_add | is_rm, counter, 0)
+    skey, sval = jax.lax.sort((key, gval), num_keys=2)
+    # last-of-run holds the segment max; zeroing the rest makes the
+    # one-hot SUM equal the segment MAX (≤ one nonzero per cell)
+    nxt = jnp.concatenate([skey[1:], jnp.full((1,), -1, skey.dtype)])
+    sval = jnp.where((skey != nxt) & (skey < sentinel), sval, 0)
+
+    # per-tile [start, mid, end): one searchsorted over tile/plane bounds
+    bounds = jnp.arange(2 * T + 1, dtype=jnp.int32) * (TILE_E * R)
+    edges = jnp.searchsorted(skey, bounds).astype(jnp.int32)
+    starts, mids, ends = edges[0:-1:2], edges[1::2], edges[2::2]
+
+    # Window size: each grid step sees two consecutive BLK-blocks of the
+    # sorted columns, chosen by scalar-prefetched block indices — a tiny
+    # sliding window instead of the whole batch resident (or re-DMA'd)
+    # per step.  Two blocks cover any tile with ≤ BLK rows, so BLK is
+    # the bucketed per-tile row maximum (fold_cap).
+    BLK = SUB
+    while BLK < tile_cap:
+        BLK *= 2
+    # pad to a BLK multiple plus one spare block (the +1 window of the
+    # last tile); padding rows are sentinels with zero values
+    Np = (-(-N // BLK) + 1) * BLK
+    skey = jnp.concatenate([skey, jnp.full((Np - N,), sentinel, jnp.int32)])
+    sval = jnp.concatenate([sval, jnp.zeros((Np - N,), jnp.int32)])
+    skey = skey.reshape(1, Np)
+    sval = sval.reshape(1, Np)
+
+    dot_dtype = jnp.int8 if dot_impl == "int8" else jnp.bfloat16
+    win_lo = pl.BlockSpec(
+        (1, BLK), lambda t, s, m, e: (0, s[t] // BLK),
+        memory_space=pltpu.VMEM,
+    )
+    # clamp: a tile whose start == N (empty trailing tile) would index
+    # one past the padded array; its loops never read the window, so any
+    # in-bounds block is fine
+    last_blk = Np // BLK - 1
+    win_hi = pl.BlockSpec(
+        (1, BLK),
+        lambda t, s, m, e: (0, jnp.minimum(s[t] // BLK + 1, last_blk)),
+        memory_space=pltpu.VMEM,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T,),
+        in_specs=[win_lo, win_hi, win_lo, win_hi],
+        out_specs=[
+            pl.BlockSpec((1, TILE_E * H, LANE), lambda t, s, m, e: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_E * H, LANE), lambda t, s, m, e: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    out_add, out_rm = pl.pallas_call(
+        partial(_fold_tile_kernel, H=H, R=R, BLK=BLK, dot_dtype=dot_dtype),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((T, TILE_E * H, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((T, TILE_E * H, LANE), jnp.int32),
+        ],
+        interpret=interpret,
+    )(starts, mids, ends, skey, skey, sval, sval)
+
+    # (T, 8H, 128) row-major ≡ (Ep, H·128) row-major: free reshape
+    add_new = out_add.reshape(Ep, H * LANE)[:E, :R]
+    rm_new = out_rm.reshape(Ep, H * LANE)[:E, :R]
+
+    # the orset_fold tail, verbatim semantics
+    clock = jnp.maximum(clock0, jnp.max(add_new, axis=0, initial=0))
+    add = jnp.maximum(add0, add_new)
+    rm = jnp.maximum(rm0, rm_new)
+    add = jnp.where(add > rm, add, 0)
+    if retire_rm:
+        rm = jnp.where(rm > clock[None, :], rm, 0)
+    return clock, add, rm
+
+
+def fold_cap(member, num_members: int) -> int:
+    """``tile_cap`` for ``orset_fold_pallas``: the max op-row count over
+    8-member tiles (conservative: counts every row, including ones the
+    kernel will sort out as padding), bucketed to a power of two so
+    recompiles stay bounded.  Determines the kernel's sliding-window
+    size; correctness requires the true per-tile count never exceed it,
+    which counting every row guarantees."""
+    import numpy as np
+
+    E = num_members
+    T = max(-(-E // TILE_E), 1)
+    counts = np.bincount(
+        np.minimum(np.asarray(member) // TILE_E, T - 1), minlength=T
+    )
+    need = int(counts.max(initial=0))
+    cap = SUB
+    while cap < need:
+        cap *= 2
+    return cap

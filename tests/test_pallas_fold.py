@@ -1,0 +1,130 @@
+"""Parity: the Pallas sorted one-hot-matmul fold (ops/pallas_fold.py)
+must be value-identical to the XLA scatter fold (ops/orset.py) — which
+tests/test_ops_kernels.py already pins byte-identical to the host
+reference — on every shape/regime the router can hand it.
+
+Runs in Pallas interpreter mode on the CPU test platform; the real-MXU
+path is exercised by bench.py on TPU with the same byte-equality check.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from crdt_enc_tpu import ops as K
+from crdt_enc_tpu.ops.pallas_fold import MAX_COUNTER, fold_cap, orset_fold_pallas
+
+
+def _gen(N, E, R, seed, max_counter=200, rm_frac=0.3, pad_frac=0.05):
+    rng = np.random.default_rng(seed)
+    kind = (rng.random(N) < rm_frac).astype(np.int8)
+    member = rng.integers(0, E, N, dtype=np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    pad = rng.random(N) < pad_frac
+    actor = np.where(pad, R, actor)
+    counter = rng.integers(1, max_counter, N, dtype=np.int32)
+    return kind, member, actor, counter
+
+
+def _run_both(clock0, add0, rm0, kind, member, actor, counter, E, R, **kw):
+    ref = K.orset_fold(
+        clock0, add0, rm0, kind, member, actor, counter,
+        num_members=E, num_replicas=R,
+        retire_rm=kw.get("retire_rm", True),
+    )
+    got = orset_fold_pallas(
+        clock0, add0, rm0, kind, member, actor, counter,
+        num_members=E, num_replicas=R, tile_cap=fold_cap(member, E),
+        interpret=True, **kw,
+    )
+    for r, g, name in zip(ref, got, ("clock", "add", "rm")):
+        np.testing.assert_array_equal(
+            np.asarray(r), np.asarray(g), err_msg=name
+        )
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize(
+    "N,E,R",
+    [
+        (256, 16, 20),  # H=1, small
+        (512, 8, 300),  # H=3, one tile
+        (777, 40, 130), # odd sizes, E not tile-aligned via Ep pad
+        (64, 3, 5),     # tiny
+    ],
+)
+def test_parity_random(N, E, R, seed):
+    rng = np.random.default_rng(seed + 100)
+    clock0 = rng.integers(0, 50, R).astype(np.int32)
+    add0 = np.zeros((E, R), np.int32)
+    rm0 = np.zeros((E, R), np.int32)
+    # a plausible starting state: some live dots above some horizons
+    add0[rng.random((E, R)) < 0.1] = 40
+    rm0[rng.random((E, R)) < 0.05] = 30
+    add0 = np.where(add0 > rm0, add0, 0)
+    rm0 = np.where(rm0 > clock0[None, :], rm0, 0)
+    kind, member, actor, counter = _gen(N, E, R, seed)
+    _run_both(clock0, add0, rm0, kind, member, actor, counter, E, R)
+
+
+def test_parity_unretired_and_empty():
+    E, R = 16, 40
+    clock0 = np.zeros(R, np.int32)
+    z = np.zeros((E, R), np.int32)
+    kind, member, actor, counter = _gen(300, E, R, 9)
+    _run_both(clock0, z, z, kind, member, actor, counter, E, R,
+              retire_rm=False)
+    # all-padding batch: nothing changes
+    actor_all_pad = np.full(128, R, np.int32)
+    _run_both(
+        clock0, z, z, np.zeros(128, np.int8), np.zeros(128, np.int32),
+        actor_all_pad, np.ones(128, np.int32), E, R,
+    )
+
+
+def test_parity_skewed_tile():
+    # every op on one member: a single tile holds the whole batch (cap
+    # grows to cover it) while other tiles are empty
+    E, R = 32, 64
+    N = 600
+    rng = np.random.default_rng(3)
+    kind = (rng.random(N) < 0.2).astype(np.int8)
+    member = np.full(N, 17, np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = rng.integers(1, 1000, N, dtype=np.int32)
+    clock0 = np.zeros(R, np.int32)
+    z = np.zeros((E, R), np.int32)
+    _run_both(clock0, z, z, kind, member, actor, counter, E, R)
+
+
+def test_parity_max_counter_boundary():
+    E, R = 8, 16
+    N = 128
+    rng = np.random.default_rng(5)
+    kind = (rng.random(N) < 0.3).astype(np.int8)
+    member = rng.integers(0, E, N, dtype=np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = np.full(N, MAX_COUNTER - 1, np.int32)
+    counter[: N // 2] = rng.integers(1, MAX_COUNTER, N // 2)
+    clock0 = np.zeros(R, np.int32)
+    z = np.zeros((E, R), np.int32)
+    _run_both(clock0, z, z, kind, member, actor, counter, E, R)
+
+
+
+
+def test_parity_exact_blk_multiple_with_empty_trailing_tile():
+    # N an exact BLK multiple with the last tiles empty: the hi-window
+    # block index of an empty trailing tile would point one past the
+    # padded array without the clamp (review finding, round 3)
+    E, R = 16, 8
+    N = 512  # == SUB == BLK for tile_cap=512
+    rng = np.random.default_rng(12)
+    kind = (rng.random(N) < 0.2).astype(np.int8)
+    member = rng.integers(0, 8, N, dtype=np.int32)  # tiles 1.. empty
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = rng.integers(1, 300, N, dtype=np.int32)
+    clock0 = np.zeros(R, np.int32)
+    z = np.zeros((E, R), np.int32)
+    _run_both(clock0, z, z, kind, member, actor, counter, E, R)
