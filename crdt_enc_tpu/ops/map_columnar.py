@@ -129,12 +129,93 @@ def decode_map_payload_batch(payloads: list, actors_sorted: list):
     return B, A, Rm, K, key_objs, member_objs
 
 
+
+def _host_scatter_phase(
+    clock0, births0, cclk0, cadd0, crm0, key_of_pair,
+    B, A, Rm, K, b_pair_a, b_pair_r, NK, R, n_groups,
+):
+    """The numpy scatter phase — the semantics reference the device twin
+    (ops/map_device.py) is fuzzed against."""
+
+    def smax(target, rows_k, rows_a, rows_c, gate=None):
+        if len(rows_k) == 0:
+            return
+        sel = slice(None)
+        if gate is not None:
+            sel = rows_c > clock0[rows_a]
+        np.maximum.at(target, (rows_k[sel], rows_a[sel]), rows_c[sel])
+
+    birth_new = np.zeros((NK, R), np.int64)
+    # every Up advances the clock
+    smax(birth_new, np.asarray(B["key"], np.int64), B["actor"], B["ctr"])
+    clock = np.maximum(clock0, birth_new.max(axis=0, initial=0))
+
+    # fire-or-defer per WHOLE remove: a remove applies only when every
+    # dot its context cites has arrived (the final clock covers it);
+    # otherwise the whole (ctx, keys) op defers verbatim.  End-of-batch
+    # firing is sequential-equivalent: once the clock covers the ctx, no
+    # dot ≤ ctx can re-enter (the replay gate holds it out).
+    group_ok = np.ones(max(n_groups, 1), bool)
+    if len(K["group"]):
+        beyond = K["ctr"] > clock[K["actor"]]
+        np.minimum.at(group_ok, K["group"], ~beyond)
+    applicable = group_ok[K["group"]] if len(K["group"]) else np.zeros(0, bool)
+
+    keyhz = np.zeros((NK, R), np.int64)
+    if applicable.any():
+        np.maximum.at(
+            keyhz,
+            (np.asarray(K["key"], np.int64)[applicable],
+             K["actor"][applicable]),
+            K["ctr"][applicable],
+        )
+
+    births = births0.copy()
+    smax(births, np.asarray(B["key"], np.int64), B["actor"], B["ctr"], gate=True)
+    births = np.where(births > keyhz, births, 0)
+
+    # child clocks advance only on child ADDS (ORSet removes never touch
+    # the clock; a child-rm Up advances the MAP clock alone); fired
+    # removes reset them
+    cclk = cclk0.copy()
+    smax(cclk, np.asarray(A["key"], np.int64), A["actor"], A["ctr"], gate=True)
+    cclk = np.where(cclk > keyhz, cclk, 0)
+
+    cadd = cadd0.copy()
+    smax(cadd, b_pair_a, A["actor"], A["ctr"], gate=True)
+    # child removes apply with their Up (replay-gated on the map dot)
+    crm = crm0.copy()
+    if len(b_pair_r):
+        live_up = Rm["mctr"] > clock0[Rm["mactor"]]
+        np.maximum.at(
+            crm,
+            (b_pair_r[live_up], Rm["actor"][live_up]),
+            Rm["ctr"][live_up],
+        )
+
+    eff_rm = np.maximum(crm, keyhz[key_of_pair])
+    cadd = np.where(cadd > eff_rm, cadd, 0)
+    # child horizons: reset by fired key removes, retired by the MAP
+    # clock (which subsumes the child clock — see
+    # CrdtMap._retire_child_horizons)
+    crm = np.where(crm > keyhz[key_of_pair], crm, 0)
+    crm = np.where(crm > clock[None, :], crm, 0)
+    return clock, births, cclk, cadd, crm, group_ok
+
+
 def crdtmap_fold_host(
-    state: CrdtMap, B, A, Rm, K, keys: Vocab, members: Vocab, replicas: Vocab
+    state: CrdtMap, B, A, Rm, K, keys: Vocab, members: Vocab, replicas: Vocab,
+    fold_impl: str = "host",
+    mesh=None,
 ) -> CrdtMap:
     """Vectorized fold of the decoded row families into ``state``
     (CrdtMap<orset>), equal to applying the batch per-op in any
-    per-actor-order-preserving interleaving."""
+    per-actor-order-preserving interleaving.
+
+    ``fold_impl="device"`` routes the scatter phase (the four
+    scatter-max families + normalization) through the jitted kernel in
+    ops/map_device.py — same planes, same values (fuzzed equal in
+    tests/test_map_columnar.py); state↔planes conversion stays host."""
     R = len(replicas)
     aidx = replicas.index
 
@@ -203,70 +284,23 @@ def crdtmap_fold_host(
     key_of_pair = uniq_pairs // NMx
 
     # ---- batch scatter-maxes --------------------------------------------
-    def smax(target, rows_k, rows_a, rows_c, gate=None):
-        if len(rows_k) == 0:
-            return
-        sel = slice(None)
-        if gate is not None:
-            sel = rows_c > clock0[rows_a]
-        np.maximum.at(target, (rows_k[sel], rows_a[sel]), rows_c[sel])
-
-    birth_new = np.zeros((NK, R), np.int64)
-    # every Up advances the clock
-    smax(birth_new, np.asarray(B["key"], np.int64), B["actor"], B["ctr"])
-    clock = np.maximum(clock0, birth_new.max(axis=0, initial=0))
-
-    # fire-or-defer per WHOLE remove: a remove applies only when every
-    # dot its context cites has arrived (the final clock covers it);
-    # otherwise the whole (ctx, keys) op defers verbatim.  End-of-batch
-    # firing is sequential-equivalent: once the clock covers the ctx, no
-    # dot ≤ ctx can re-enter (the replay gate holds it out).
     n_groups = int(K["group"].max()) + 1 if len(K["group"]) else 0
-    group_ok = np.ones(max(n_groups, 1), bool)
-    if len(K["group"]):
-        beyond = K["ctr"] > clock[K["actor"]]
-        np.minimum.at(group_ok, K["group"], ~beyond)
-    applicable = group_ok[K["group"]] if len(K["group"]) else np.zeros(0, bool)
+    if fold_impl == "device":
+        from .map_device import crdtmap_scatter_device
 
-    keyhz = np.zeros((NK, R), np.int64)
-    if applicable.any():
-        np.maximum.at(
-            keyhz,
-            (np.asarray(K["key"], np.int64)[applicable],
-             K["actor"][applicable]),
-            K["ctr"][applicable],
+        clock, births, cclk, cadd, crm, group_ok = crdtmap_scatter_device(
+            clock0, births0, cclk0, cadd0, crm0, key_of_pair,
+            B, {**A, "pair": b_pair_a}, {**Rm, "pair": b_pair_r}, K,
+            n_groups, mesh=mesh,
         )
-
-    births = births0.copy()
-    smax(births, np.asarray(B["key"], np.int64), B["actor"], B["ctr"], gate=True)
-    births = np.where(births > keyhz, births, 0)
-
-    # child clocks advance only on child ADDS (ORSet removes never touch
-    # the clock; a child-rm Up advances the MAP clock alone); fired
-    # removes reset them
-    cclk = cclk0.copy()
-    smax(cclk, np.asarray(A["key"], np.int64), A["actor"], A["ctr"], gate=True)
-    cclk = np.where(cclk > keyhz, cclk, 0)
-
-    cadd = cadd0.copy()
-    smax(cadd, b_pair_a, A["actor"], A["ctr"], gate=True)
-    # child removes apply with their Up (replay-gated on the map dot)
-    crm = crm0.copy()
-    if len(b_pair_r):
-        live_up = Rm["mctr"] > clock0[Rm["mactor"]]
-        np.maximum.at(
-            crm,
-            (b_pair_r[live_up], Rm["actor"][live_up]),
-            Rm["ctr"][live_up],
+        group_ok_pad = np.ones(max(n_groups, 1), bool)
+        group_ok_pad[:n_groups] = group_ok
+        group_ok = group_ok_pad
+    else:
+        clock, births, cclk, cadd, crm, group_ok = _host_scatter_phase(
+            clock0, births0, cclk0, cadd0, crm0, key_of_pair,
+            B, A, Rm, K, b_pair_a, b_pair_r, NK, R, n_groups,
         )
-
-    eff_rm = np.maximum(crm, keyhz[key_of_pair])
-    cadd = np.where(cadd > eff_rm, cadd, 0)
-    # child horizons: reset by fired key removes, retired by the MAP
-    # clock (which subsumes the child clock — see
-    # CrdtMap._retire_child_horizons)
-    crm = np.where(crm > keyhz[key_of_pair], crm, 0)
-    crm = np.where(crm > clock[None, :], crm, 0)
 
     # ---- planes → state --------------------------------------------------
     robj = replicas.items

@@ -1,0 +1,232 @@
+"""Device (jit) scatter phase for the causal reset-remove map fold.
+
+``ops/map_columnar.py`` decomposes a CrdtMap<orset> op batch into four
+row families folded over two plane sets — key planes ``(NK, R)`` and
+touched-pair planes ``(NP, R)``.  Its scatter phase is masked
+scatter-max / segment-min work structurally identical to the ORSet
+kernel (``ops/orset.py``), so this module jits it with the same
+conventions: int32 planes, 0 = absent, sentinel ``actor == R`` padding
+rows, bucket-padded static shapes.
+
+The host numpy phase in map_columnar stays the semantics reference; the
+wrapper here is routed by ``TpuAccelerator._fold_map_payloads`` for
+device-worthy batches and fuzz-checked equal in
+tests/test_map_columnar.py.
+
+Reference analogue: the composite-CRDT merge discipline of
+crdt-enc/src/key_cryptor.rs:35-52 (MVReg+Orswot `Keys`), generalized to
+the crdts-crate Map's reset-remove semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "num_keys", "num_pairs", "num_replicas", "num_groups", "axis_name",
+    ),
+)
+def crdtmap_scatter_phase(
+    clock0,  # (R,) int32
+    births0,  # (NK, R) int32
+    cclk0,  # (NK, R) int32
+    cadd0,  # (NP, R) int32
+    crm0,  # (NP, R) int32
+    key_of_pair,  # (NP,) int32
+    b_key, b_actor, b_ctr,  # births (Up dots); actor == R ⇒ padding
+    k_key, k_actor, k_ctr, k_group,  # key-remove horizon rows
+    a_key, a_pair, a_actor, a_ctr,  # child adds (shared map dot)
+    r_pair, r_actor, r_ctr, r_mactor, r_mctr,  # child-remove horizons
+    *,
+    num_keys: int,
+    num_pairs: int,
+    num_replicas: int,
+    num_groups: int,
+    axis_name: str | None = None,
+):
+    """The batch scatter-maxes + normalization of ``crdtmap_fold_host``
+    (map_columnar.py), one jitted program.  Returns
+    ``(clock, births, cclk, cadd, crm, group_ok)`` with the same
+    values the host numpy phase computes (int32).
+
+    ``axis_name``: when set, the caller runs this body under
+    ``shard_map`` with the ROW families sharded over that axis and the
+    planes replicated; each scatter's partial result combines across the
+    axis with ``pmax`` (``pmin`` for the remove-group applicability),
+    after which the replicated normalization is identical on every
+    device — the same partial-fold/​combine shape as the sharded ORSet
+    fold (mesh.py)."""
+    NK, NP, R = num_keys, num_pairs, num_replicas
+
+    def smax(shape_cells, rows_seg, rows_c, gate):
+        vals = jnp.where(gate, rows_c, 0)
+        z = jnp.zeros((shape_cells,), jnp.int32)
+        out = z.at[rows_seg].max(vals, mode="drop")
+        if axis_name is not None:
+            out = jax.lax.pmax(out, axis_name)
+        return out
+
+    def seg(key_col, actor_col):
+        a_ix = jnp.minimum(actor_col, R - 1)
+        return key_col * R + a_ix
+
+    b_pad = b_actor >= R
+    k_pad = k_actor >= R
+    a_pad = a_actor >= R
+    r_pad = r_actor >= R
+
+    # 1. every Up advances the clock (ungated birth scatter)
+    birth_new = smax(NK * R, seg(b_key, b_actor), b_ctr, ~b_pad).reshape(NK, R)
+    clock = jnp.maximum(clock0, jnp.max(birth_new, axis=0, initial=0))
+
+    # 2. fire-or-defer per WHOLE remove: segment-min over each remove
+    #    group of "the final clock covers this ctx dot"
+    k_actor_ix = jnp.minimum(k_actor, R - 1)
+    beyond = (k_ctr > clock[k_actor_ix]) & ~k_pad
+    g_ix = jnp.where(k_pad, num_groups, k_group)
+    ok_i = jnp.ones((num_groups,), jnp.int32).at[g_ix].min(
+        jnp.where(beyond, 0, 1), mode="drop"
+    )
+    if axis_name is not None:
+        ok_i = jax.lax.pmin(ok_i, axis_name)
+    group_ok = ok_i.astype(bool)
+    applicable = group_ok[jnp.minimum(k_group, num_groups - 1)] & ~k_pad \
+        if num_groups else jnp.zeros_like(k_pad)
+
+    # 3. fired key-remove horizons
+    keyhz = smax(
+        NK * R, seg(k_key, k_actor), k_ctr, applicable
+    ).reshape(NK, R)
+
+    # 4. births: replay-gated on the ORIGINAL clock, reset by horizons
+    b_gate = ~b_pad & (b_ctr > clock0[jnp.minimum(b_actor, R - 1)])
+    births = jnp.maximum(
+        births0, smax(NK * R, seg(b_key, b_actor), b_ctr, b_gate).reshape(NK, R)
+    )
+    births = jnp.where(births > keyhz, births, 0)
+
+    # 5. child clocks advance on child ADDS only; fired removes reset them
+    a_gate = ~a_pad & (a_ctr > clock0[jnp.minimum(a_actor, R - 1)])
+    cclk = jnp.maximum(
+        cclk0, smax(NK * R, seg(a_key, a_actor), a_ctr, a_gate).reshape(NK, R)
+    )
+    cclk = jnp.where(cclk > keyhz, cclk, 0)
+
+    # 6. child entries (pair planes), same replay gate
+    cadd = jnp.maximum(
+        cadd0, smax(NP * R, seg(a_pair, a_actor), a_ctr, a_gate).reshape(NP, R)
+    )
+
+    # 7. child-remove horizons apply with their Up (gated on the MAP dot)
+    live_up = ~r_pad & (r_mctr > clock0[jnp.minimum(r_mactor, R - 1)])
+    crm = jnp.maximum(
+        crm0, smax(NP * R, seg(r_pair, r_actor), r_ctr, live_up).reshape(NP, R)
+    )
+
+    # 8. normalization: fired key horizons kill covered child content;
+    #    the MAP clock retires child horizons
+    hz_of_pair = keyhz[jnp.minimum(key_of_pair, NK - 1)]
+    eff_rm = jnp.maximum(crm, hz_of_pair)
+    cadd = jnp.where(cadd > eff_rm, cadd, 0)
+    crm = jnp.where(crm > hz_of_pair, crm, 0)
+    crm = jnp.where(crm > clock[None, :], crm, 0)
+    return clock, births, cclk, cadd, crm, group_ok
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_rows(arrs, n_to, fills):
+    out = []
+    for a, fill in zip(arrs, fills):
+        a = np.asarray(a, np.int32)
+        padn = n_to - len(a)
+        out.append(np.concatenate([a, np.full(padn, fill, np.int32)])
+                   if padn else a)
+    return out
+
+
+def crdtmap_scatter_device(
+    clock0, births0, cclk0, cadd0, crm0, key_of_pair, B, A, Rm, K,
+    n_groups: int,
+    mesh=None,
+):
+    """Bucket-pad the planes/rows (bounded recompiles) and run the jitted
+    scatter phase — single-device, or SPMD over ``mesh`` (rows sharded
+    dp, planes replicated; parallel/mesh.crdtmap_scatter_sharded).
+    Inputs are the host fold's numpy planes (any integer dtype that fits
+    int32) and the four decoded row-family dicts; returns int64 planes +
+    group_ok, shaped exactly as the host phase's."""
+    NK, R = births0.shape
+    NP = cadd0.shape[0] if cadd0.size else 0
+    NKp, NPp = _bucket(max(NK, 1)), _bucket(max(NP, 1))
+    Rp = _bucket(R)
+    clock0p = np.zeros(Rp, np.int32)
+    clock0p[:R] = clock0
+    def pad2(p, nk):
+        p = np.asarray(p, np.int32)
+        out = np.zeros((nk, Rp), np.int32)
+        if p.size:
+            out[: p.shape[0], :R] = p
+        return out
+
+    births0p = pad2(births0, NKp)
+    cclk0p = pad2(cclk0, NKp)
+    cadd0p = pad2(cadd0, NPp)
+    crm0p = pad2(crm0, NPp)
+    kop = np.zeros(NPp, np.int32)
+    if NP:
+        kop[:NP] = key_of_pair
+
+    dp = mesh.shape["dp"] if mesh is not None else 1
+
+    def rows(d, names, fills, n):
+        nb = _bucket(max(n, 1), floor=8)
+        nb = -(-nb // dp) * dp
+        return _pad_rows([d[x] for x in names], nb, fills)
+
+    b_rows = rows(B, ("key", "actor", "ctr"), (0, Rp, 0), len(B["actor"]))
+    k_rows = rows(
+        K, ("key", "actor", "ctr", "group"), (0, Rp, 0, 0), len(K["actor"])
+    )
+    a_rows = rows(
+        A, ("key", "pair", "actor", "ctr"), (0, 0, Rp, 0), len(A["actor"])
+    )
+    r_rows = rows(
+        Rm, ("pair", "actor", "ctr", "mactor", "mctr"), (0, Rp, 0, Rp, 0),
+        len(Rm["actor"]),
+    )
+    ngp = max(_bucket(max(n_groups, 1), floor=1), 1)
+    if mesh is not None and mesh.size > 1:
+        from ..parallel import mesh as pmesh
+
+        out = pmesh.crdtmap_scatter_sharded(
+            mesh, clock0p, births0p, cclk0p, cadd0p, crm0p, kop,
+            b_rows, k_rows, a_rows, r_rows, num_groups=ngp,
+        )
+    else:
+        out = crdtmap_scatter_phase(
+            clock0p, births0p, cclk0p, cadd0p, crm0p, kop,
+            *b_rows, *k_rows, *a_rows, *r_rows,
+            num_keys=NKp, num_pairs=NPp, num_replicas=Rp, num_groups=ngp,
+        )
+    clock, births, cclk, cadd, crm, group_ok = (np.asarray(x) for x in out)
+    return (
+        clock[:R].astype(np.int64),
+        births[:NK, :R].astype(np.int64),
+        cclk[:NK, :R].astype(np.int64),
+        cadd[:NP, :R].astype(np.int64),
+        crm[:NP, :R].astype(np.int64),
+        group_ok[:n_groups].astype(bool) if n_groups else group_ok[:0].astype(bool),
+    )

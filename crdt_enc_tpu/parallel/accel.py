@@ -49,9 +49,14 @@ class TpuAccelerator(HostAccelerator):
         min_device_batch: int = MIN_DEVICE_BATCH,
         mesh=None,
         sparse_device: bool = False,
+        map_fold_impl: str | None = None,
     ):
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # CrdtMap scatter phase: "host" (numpy reference), "device"
+        # (ops/map_device.py jit), or None = device for batches past
+        # min_device_batch
+        self.map_fold_impl = map_fold_impl
         # sparse-regime folds default to the vectorized host sort (numpy
         # lexsort beats the TPU's bitonic sort ~25× at these shapes and no
         # planes exist to ship — see orset_fold_sparse_host).  Opt in to
@@ -272,6 +277,18 @@ class TpuAccelerator(HostAccelerator):
 
         if isinstance(state, CrdtMap):
             return self._fold_map_payloads(state, payloads, actors_hint)
+        from ..models import GSet, LWWReg, MVReg, MerkleReg, SeqList
+
+        if isinstance(state, GSet):
+            return self._fold_gset_payloads(state, payloads)
+        if isinstance(state, LWWReg):
+            return self._fold_lwwreg_payloads(state, payloads)
+        if isinstance(state, MVReg):
+            return self._fold_mvreg_payloads(state, payloads)
+        if isinstance(state, SeqList):
+            return self._fold_seqlist_payloads(state, payloads)
+        if isinstance(state, MerkleReg):
+            return self._fold_merklereg_payloads(state, payloads)
         if not isinstance(state, ORSet):
             return False
         from ..ops.native_decode import decode_orset_payload_batch
@@ -379,8 +396,120 @@ class TpuAccelerator(HostAccelerator):
         if len(keys) != len(key_objs) or len(members) != len(member_objs):
             return False
         replicas = K.Vocab(actors_sorted)
+        impl = self.map_fold_impl
+        if impl is None and self._mesh_active():
+            impl = "device"  # SPMD scatter phase over the mesh
+        elif impl is None:
+            n_rows = (
+                len(B["actor"]) + len(A["actor"]) + len(Rm["actor"])
+                + len(Kk["actor"])
+            )
+            impl = "device" if n_rows >= self.min_device_batch else "host"
         with trace.span("fold.map"):
-            crdtmap_fold_host(state, B, A, Rm, Kk, keys, members, replicas)
+            crdtmap_fold_host(
+                state, B, A, Rm, Kk, keys, members, replicas, fold_impl=impl,
+                mesh=self.mesh
+                if impl == "device" and self._mesh_active()
+                else None,
+            )
+        return True
+
+    # -------------------------------------------- catalogue bulk front ends
+    def _fold_gset_payloads(self, state, payloads: list) -> bool:
+        """G-Set bulk: one msgpack unpack per file, one set update.  No
+        device path — the fold IS deduplication of opaque values, which
+        is exactly what hashing them into the host set does; there is no
+        arithmetic to put on the MXU/VPU (docs/PARITY.md row 14)."""
+        from ..utils import codec
+
+        frozen = state._freeze
+        state.members.update(
+            frozen(op) for p in payloads for op in codec.unpack(p)
+        )
+        return True
+
+    def _fold_lwwreg_payloads(self, state, payloads: list) -> bool:
+        """LWW-Register bulk: the LWW-map cascade at K=1 — one device
+        ``lww_fold`` over all writes, winner resolved against the slot
+        with the host tie-break (identical total order: the columns are
+        rank-interned so integer compare ≡ bytes compare)."""
+        from ..models.lwwmap import LWWOp
+        from ..utils import codec
+
+        rows = [op for p in payloads for op in codec.unpack(p)]
+        if not rows:
+            return True
+        if len(rows) < self.min_device_batch:
+            for o in rows:
+                state.apply(o)
+            return True
+        ops = [
+            LWWOp(None, int(o[0]), bytes(o[1]), o[2], False) for o in rows
+        ]
+        cols = K.lww_ops_to_columns(ops)
+        V = len(cols.values_sorted)
+        num_values = V if len(cols.actors_sorted) * V < 2**31 else None
+        m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
+            cols.key, cols.ts_hi, cols.ts_lo, cols.actor, cols.value,
+            num_keys=1, num_values=num_values,
+        )
+        if not bool(np.asarray(present)[0]):
+            return True
+        ts = (int(np.asarray(m_hi)[0]) << 31) | int(np.asarray(m_lo)[0])
+        actor = cols.actors_sorted[int(np.asarray(m_actor)[0])]
+        value = cols.values_sorted[int(np.asarray(m_value)[0])]
+        state._take(ts, actor, value)
+        return True
+
+    def _fold_mvreg_payloads(self, state, payloads: list) -> bool:
+        """MVReg bulk fold: ops are (clock, value) candidates; iterated
+        strict-dominance apply equals the global anti-chain (dominance is
+        transitive), so one ``mvreg_dominance_keep`` call replaces the
+        per-op loop — the same argument ``_merge_mvregs`` documents."""
+        from ..models.vclock import VClock as VC
+        from ..utils import codec
+
+        pairs = list(state.vals)
+        n_ops = 0
+        for p in payloads:
+            for obj in codec.unpack(p):
+                pairs.append((VC.from_obj(obj[0]), obj[1]))
+                n_ops += 1
+        if n_ops == 0:
+            return True
+        if n_ops + len(state.vals) < self.min_device_batch:
+            from ..models.mvreg import MVRegOp
+
+            for c, v in pairs[len(state.vals):]:
+                state.apply(MVRegOp(c, v))
+            return True
+        self._mvreg_antichain(state, pairs)
+        return True
+
+    def _fold_seqlist_payloads(self, state, payloads: list) -> bool:
+        """SeqList bulk: whole-file unpack, vectorized-enough host apply.
+        No device kernel: the state is an order-keyed tree of opaque
+        idents (Logoot paths) — resolving it is pointer/compare work on
+        variable-length paths with no dense tensor shape
+        (docs/PARITY.md row 14)."""
+        from ..models.seqlist import op_from_obj
+        from ..utils import codec
+
+        for p in payloads:
+            for obj in codec.unpack(p):
+                state.apply(op_from_obj(obj))
+        return True
+
+    def _fold_merklereg_payloads(self, state, payloads: list) -> bool:
+        """MerkleReg bulk: whole-file unpack + apply.  No device kernel:
+        the fold is hash-DAG bookkeeping (parent links, head set), not
+        arithmetic (docs/PARITY.md row 14)."""
+        from ..models.merkle_reg import MerkleNode
+        from ..utils import codec
+
+        for p in payloads:
+            for obj in codec.unpack(p):
+                state.apply(MerkleNode.from_obj(obj))
         return True
 
     def _fold_counter_payloads(self, state, payloads: list, actors_hint=()) -> bool:
@@ -589,6 +718,11 @@ class TpuAccelerator(HostAccelerator):
         pairs = list(state.vals)
         for o in others:
             pairs.extend(o.vals)
+        return self._mvreg_antichain(state, pairs)
+
+    def _mvreg_antichain(self, state, pairs: list):
+        """Write the global strict-dominance anti-chain of ``pairs`` into
+        ``state`` via one ``mvreg_dominance_keep`` kernel call."""
         replicas = K.Vocab()
         for c, _ in pairs:
             for a in c.counters:
@@ -601,13 +735,21 @@ class TpuAccelerator(HostAccelerator):
         # bucket-pad both axes so repeated merges reuse the compiled
         # program: zero rows are masked out via `valid`, zero columns are
         # inert (elementwise comparisons on equal zeros)
-        clocks = np.zeros((_bucket(V), _bucket(R)), np.int32)
+        Vp = self._round_to(_bucket(V), self._dp())
+        clocks = np.zeros((Vp, _bucket(R)), np.int32)
         for i, (c, _) in enumerate(pairs):
             for a, n in c.counters.items():
                 clocks[i, replicas.intern(a)] = n
         valid = np.zeros(len(clocks), bool)
         valid[:V] = True
-        keep = np.asarray(K.mvreg_dominance_keep(clocks, valid))
+        if self._mesh_active():
+            from . import mesh as pmesh
+
+            keep = np.asarray(
+                pmesh.mvreg_keep_sharded(self.mesh, clocks, valid)
+            )
+        else:
+            keep = np.asarray(K.mvreg_dominance_keep(clocks, valid))
         state.vals = [pairs[i] for i in np.flatnonzero(keep[:V])]
         state._canonicalize()
         return state

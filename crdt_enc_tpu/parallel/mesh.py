@@ -240,3 +240,85 @@ def lww_fold_sharded(mesh: Mesh, key, ts_hi, ts_lo, actor, value, *, num_keys: i
         check_vma=False,
     )
     return fold(key, ts_hi, ts_lo, actor, value)
+
+
+# ---- CrdtMap --------------------------------------------------------------
+
+
+def crdtmap_scatter_sharded(
+    mesh: Mesh,
+    clock0, births0, cclk0, cadd0, crm0, key_of_pair,
+    b_rows, k_rows, a_rows, r_rows,
+    *, num_groups: int,
+):
+    """Sharded CrdtMap scatter phase: the four row families shard over
+    ``dp`` (each padded to a dp multiple with ``actor == R`` sentinels);
+    the key/pair planes are replicated — map workloads are row-heavy and
+    plane-light (NK·R and NP·R are bounded by the touched vocabulary,
+    not the batch), the opposite regime from the ORSet fold's mp axis.
+    Each scatter combines across dp with one ``pmax`` (``pmin`` for the
+    remove-group gate) inside ops/map_device.crdtmap_scatter_phase."""
+    from ..ops.map_device import crdtmap_scatter_phase
+
+    dp = mesh.shape["dp"]
+    for fam in (b_rows, k_rows, a_rows, r_rows):
+        if len(fam[0]) % dp:
+            raise ValueError(f"pad row families to dp={dp} multiples first")
+    NK, R = births0.shape
+    NP = cadd0.shape[0]
+
+    def body(c0, b0, cc0, ca0, cr0, kop, *rows):
+        b = rows[0:3]
+        k = rows[3:7]
+        a = rows[7:11]
+        r = rows[11:16]
+        return crdtmap_scatter_phase(
+            c0, b0, cc0, ca0, cr0, kop, *b, *k, *a, *r,
+            num_keys=NK, num_pairs=NP, num_replicas=R,
+            num_groups=num_groups, axis_name="dp",
+        )
+
+    n_rows = 3 + 4 + 4 + 5
+    fold = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P()) + (P("dp"),) * n_rows,
+        out_specs=(P(),) * 6,
+        check_vma=False,
+    )
+    return fold(
+        clock0, births0, cclk0, cadd0, crm0, key_of_pair,
+        *b_rows, *k_rows, *a_rows, *r_rows,
+    )
+
+
+# ---- MVReg ----------------------------------------------------------------
+
+
+def mvreg_keep_sharded(mesh: Mesh, clocks, valid):
+    """Sharded MVReg dominance filter: candidate rows shard over ``dp``
+    (pad V to a dp multiple with invalid rows); each device all_gathers
+    the full candidate set (V·R is small — clocks, not payloads) and
+    filters its slice, so the O(V²R) compare matrix is split V/dp ways.
+    Same contract as ops/mvreg.mvreg_dominance_keep."""
+    dp = mesh.shape["dp"]
+    V, R = clocks.shape
+    if V % dp:
+        raise ValueError(f"pad candidates {V} to a dp={dp} multiple first")
+
+    def body(c_slice, v_slice):
+        full_c = jax.lax.all_gather(c_slice, "dp", tiled=True)  # (V, R)
+        full_v = jax.lax.all_gather(v_slice, "dp", tiled=True)  # (V,)
+        ge = jnp.all(full_c[:, None, :] >= c_slice[None, :, :], axis=-1)
+        gt = jnp.any(full_c[:, None, :] > c_slice[None, :, :], axis=-1)
+        dominated = jnp.any((ge & gt) & full_v[:, None], axis=0)
+        return v_slice & ~dominated
+
+    keep = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("dp", None), P("dp")),
+        out_specs=P("dp"),
+        check_vma=False,
+    )
+    return keep(clocks, valid)

@@ -89,9 +89,7 @@ def test_bulk_ingest_matches_per_file(reader_accel, monkeypatch):
         )
         await ref_reader.read_remote()
 
-        assert canonical_bytes(bulk_reader.with_state(lambda s: s)) == canonical_bytes(
-            ref_reader.with_state(lambda s: s)
-        )
+        assert bulk_reader.with_state(canonical_bytes) == ref_reader.with_state(canonical_bytes)
         assert (
             bulk_reader.info().next_op_versions.to_obj()
             == ref_reader.info().next_op_versions.to_obj()
@@ -160,9 +158,7 @@ def test_bulk_ingest_counters_match_per_file(kind, monkeypatch):
         assert bulk.with_state(lambda s: s.read()) == ref.with_state(
             lambda s: s.read()
         )
-        assert canonical_bytes(bulk.with_state(lambda s: s)) == canonical_bytes(
-            ref.with_state(lambda s: s)
-        )
+        assert bulk.with_state(canonical_bytes) == ref.with_state(canonical_bytes)
 
     run(go())
 
@@ -349,9 +345,7 @@ def test_bulk_gap_leaves_cursors_consistent(monkeypatch):
 
         ref = await Core.open(make_opts(MemoryStorage(remote), orset_adapter()))
         await ref.read_remote()
-        assert canonical_bytes(reader.with_state(lambda s: s)) == canonical_bytes(
-            ref.with_state(lambda s: s)
-        )
+        assert reader.with_state(canonical_bytes) == ref.with_state(canonical_bytes)
         assert (
             reader.info().next_op_versions.to_obj()
             == ref.info().next_op_versions.to_obj()
@@ -391,9 +385,7 @@ def test_bulk_stream_path_matches_per_file(monkeypatch):
         ref = await Core.open(make_opts(MemoryStorage(remote), orset_adapter()))
         await ref.read_remote()
 
-        assert canonical_bytes(reader.with_state(lambda s: s)) == canonical_bytes(
-            ref.with_state(lambda s: s)
-        )
+        assert reader.with_state(canonical_bytes) == ref.with_state(canonical_bytes)
         assert (
             reader.info().next_op_versions.to_obj()
             == ref.info().next_op_versions.to_obj()

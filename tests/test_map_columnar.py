@@ -38,10 +38,14 @@ def _payloads_from_streams(m, streams, per_file=3):
     return [codec.pack(f) for f in files]
 
 
-def test_columnar_fold_matches_host_fuzz():
+import pytest
+
+
+@pytest.mark.parametrize("impl", ["host", "device"])
+def test_columnar_fold_matches_host_fuzz(impl):
     rng = random.Random(7)
     proto = CrdtMap(child=b"orset")
-    for trial in range(400):
+    for trial in range(400 if impl == "host" else 150):
         n = rng.randrange(0, 30)
         script = [
             (rng.randrange(4),
@@ -51,7 +55,7 @@ def test_columnar_fold_matches_host_fuzz():
         ]
         oracle, streams = orset_child_history(script)
         payloads = _payloads_from_streams(proto, streams)
-        accel = TpuAccelerator(min_device_batch=1)
+        accel = TpuAccelerator(min_device_batch=1, map_fold_impl=impl)
         folded = CrdtMap(child=b"orset")
         ok = accel.fold_payloads(folded, payloads, actors_hint=ACTORS)
         assert ok, f"trial {trial}: accelerator declined"
@@ -60,12 +64,13 @@ def test_columnar_fold_matches_host_fuzz():
         )
 
 
-def test_columnar_fold_into_populated_state():
+@pytest.mark.parametrize("impl", ["host", "device"])
+def test_columnar_fold_into_populated_state(impl):
     """Fold the second half of a history into the state built per-op from
     the first half — cursor-style incremental ingest."""
     rng = random.Random(11)
     proto = CrdtMap(child=b"orset")
-    for trial in range(200):
+    for trial in range(200 if impl == "host" else 100):
         n = rng.randrange(4, 30)
         script = [
             (rng.randrange(4),
@@ -83,7 +88,7 @@ def test_columnar_fold_into_populated_state():
                 base.apply(op)
             tails.append(s[half:])
         payloads = _payloads_from_streams(proto, tails)
-        accel = TpuAccelerator(min_device_batch=1)
+        accel = TpuAccelerator(min_device_batch=1, map_fold_impl=impl)
         ok = accel.fold_payloads(base, payloads, actors_hint=ACTORS)
         assert ok, f"trial {trial}: declined"
         assert canonical_bytes(base) == canonical_bytes(oracle), (
@@ -143,15 +148,11 @@ def test_map_bulk_ingest_through_core():
         await r.read_remote()
         ref = await Core.open(opts(remote))
         await ref.read_remote()
-        assert canonical_bytes(r.with_state(lambda s: s)) == canonical_bytes(
-            ref.with_state(lambda s: s)
-        )
+        assert r.with_state(canonical_bytes) == ref.with_state(canonical_bytes)
         # and the compaction snapshot round-trips
         await r.compact()
         f = await Core.open(opts(remote))
         await f.read_remote()
-        assert canonical_bytes(f.with_state(lambda s: s)) == canonical_bytes(
-            r.with_state(lambda s: s)
-        )
+        assert f.with_state(canonical_bytes) == r.with_state(canonical_bytes)
 
     asyncio.run(go())
