@@ -1,0 +1,170 @@
+"""Bulk (fold_payloads) front ends for the rest of the CRDT catalogue —
+GSet, LWWReg, MVReg, SeqList, MerkleReg — must equal per-op apply
+(round-3 item: every catalogue type accepted by the bulk surface).
+
+The LWWReg and MVReg paths route through the device kernels
+(``lww_fold`` at K=1, ``mvreg_dominance_keep``); GSet/SeqList/MerkleReg
+are host folds by design (docs/PARITY.md row 14 documents why no device
+kernel exists for them)."""
+
+from __future__ import annotations
+
+import random
+import uuid
+
+import pytest
+
+from crdt_enc_tpu.models import (
+    GSet, LWWReg, MVReg, MerkleReg, SeqList, canonical_bytes,
+)
+from crdt_enc_tpu.parallel.accel import TpuAccelerator
+from crdt_enc_tpu.utils import codec
+
+ACTORS = [uuid.UUID(int=i + 1).bytes for i in range(4)]
+
+
+def _seal(op_objs, per_file=5):
+    return [
+        codec.pack(op_objs[i : i + per_file])
+        for i in range(0, len(op_objs), per_file)
+    ]
+
+
+def _check(proto_cls, ops_to_obj, make_ops, accel, seed=0, **proto_kw):
+    rng = random.Random(seed)
+    ops = make_ops(rng)
+    objs = [ops_to_obj(op) for op in ops]
+    ref = proto_cls(**proto_kw)
+    for op in ops:
+        ref.apply(op)
+    bulk = proto_cls(**proto_kw)
+    ok = accel.fold_payloads(bulk, _seal(objs))
+    assert ok, "bulk path declined"
+    assert canonical_bytes(bulk) == canonical_bytes(ref)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_gset_bulk(seed):
+    def make(rng):
+        return [rng.randrange(20) for _ in range(rng.randrange(0, 60))]
+
+    _check(GSet, lambda op: op, make, TpuAccelerator(), seed)
+
+
+@pytest.mark.parametrize("min_batch", [1, 10**6])  # device and host routes
+@pytest.mark.parametrize("seed", range(5))
+def test_lwwreg_bulk(seed, min_batch):
+    def make(rng):
+        return [
+            LWWReg().write(
+                rng.randrange(100), rng.choice(ACTORS), rng.randrange(5)
+            )
+            for _ in range(rng.randrange(1, 50))
+        ]
+
+    _check(
+        LWWReg, lambda op: op.to_obj(), make,
+        TpuAccelerator(min_device_batch=min_batch), seed,
+    )
+
+
+@pytest.mark.parametrize("min_batch", [1, 10**6])
+@pytest.mark.parametrize("seed", range(5))
+def test_mvreg_bulk(seed, min_batch):
+    def make(rng):
+        # concurrent writers with partially-ordered clocks: each actor
+        # writes from its own (occasionally synced) view
+        reg_views = [MVReg() for _ in ACTORS]
+        ops = []
+        for _ in range(rng.randrange(1, 40)):
+            i = rng.randrange(len(ACTORS))
+            op = reg_views[i].write_ctx(ACTORS[i], rng.randrange(10))
+            ops.append(op)
+            reg_views[i].apply(op)
+            if rng.random() < 0.3:  # occasionally sync another view
+                j = rng.randrange(len(ACTORS))
+                reg_views[j].merge(reg_views[i])
+        return ops
+
+    _check(
+        MVReg, lambda op: [op.clock.to_obj(), op.value], make,
+        TpuAccelerator(min_device_batch=min_batch), seed,
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_seqlist_bulk(seed):
+    def make(rng):
+        view = SeqList()
+        ops = []
+        for _ in range(rng.randrange(1, 40)):
+            if view.read() and rng.random() < 0.3:
+                op = view.delete_ctx(rng.randrange(len(view.read())))
+            else:
+                op = view.insert_ctx(
+                    rng.choice(ACTORS),
+                    rng.randrange(len(view.read()) + 1),
+                    rng.randrange(100),
+                )
+            ops.append(op)
+            view.apply(op)
+        return ops
+
+    _check(SeqList, lambda op: op.to_obj(), make, TpuAccelerator(), seed)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_merklereg_bulk(seed):
+    def make(rng):
+        view = MerkleReg()
+        ops = []
+        for _ in range(rng.randrange(1, 30)):
+            op = view.write_ctx(rng.randrange(50))
+            ops.append(op)
+            view.apply(op)
+        return ops
+
+    _check(
+        MerkleReg, lambda op: op.to_obj(), make, TpuAccelerator(), seed
+    )
+
+
+def test_lwwreg_bulk_into_populated_state():
+    accel = TpuAccelerator(min_device_batch=1)
+    ref = LWWReg()
+    bulk = LWWReg()
+    first = LWWReg().write(50, ACTORS[0], "existing")
+    ref.apply(first)
+    bulk.apply(first)
+    ops = [LWWReg().write(ts, ACTORS[1], f"v{ts}") for ts in (10, 60, 40)]
+    for op in ops:
+        ref.apply(op)
+    assert accel.fold_payloads(bulk, _seal([o.to_obj() for o in ops]))
+    assert canonical_bytes(bulk) == canonical_bytes(ref)
+    # stale batch: populated slot must survive
+    ops2 = [LWWReg().write(5, ACTORS[2], "old")]
+    ref2, bulk2 = LWWReg(), LWWReg()
+    ref2.apply(first), bulk2.apply(first)
+    for op in ops2:
+        ref2.apply(op)
+    assert accel.fold_payloads(bulk2, _seal([o.to_obj() for o in ops2]))
+    assert canonical_bytes(bulk2) == canonical_bytes(ref2)
+
+
+def test_mvreg_bulk_into_populated_state():
+    accel = TpuAccelerator(min_device_batch=1)
+    base = MVReg()
+    w = base.write_ctx(ACTORS[0], "a")
+    ref = MVReg()
+    ref.apply(w)
+    bulk = MVReg()
+    bulk.apply(w)
+    # a dominating write and an unrelated concurrent one
+    op2 = ref.write_ctx(ACTORS[1], "b")
+    solo = MVReg()
+    op3 = solo.write_ctx(ACTORS[2], "c")
+    for op in (op2, op3):
+        ref.apply(op)
+    objs = [[op.clock.to_obj(), op.value] for op in (op2, op3)]
+    assert accel.fold_payloads(bulk, _seal(objs))
+    assert canonical_bytes(bulk) == canonical_bytes(ref)
