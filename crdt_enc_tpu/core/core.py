@@ -32,6 +32,7 @@ import uuid
 from dataclasses import dataclass, field
 
 from ..models import MVReg, VClock
+from ..utils.lockbox import LockBox
 from ..models.vclock import Actor, Dot
 from ..utils import VersionBytes, codec, trace
 from ..utils.versions import (
@@ -272,10 +273,12 @@ class Core:
     def with_state(self, fn):
         """Run ``fn(state)`` synchronously under the data-lock discipline —
         the way applications build ops against current state
-        (reference lib.rs:325-330)."""
-        if asyncio.iscoroutinefunction(fn):
-            raise TypeError("with_state callbacks must be synchronous (LockBox)")
-        return fn(self._data.state)
+        (reference lib.rs:325-330).  The LockBox mechanism
+        (utils/lockbox.py) enforces the discipline at runtime: ``fn`` gets
+        a revocable borrow, so a retained state reference used after the
+        section (the Python shape of holding the lock across an await)
+        raises instead of racing; awaitable returns are rejected."""
+        return LockBox(self._data.state).with_(fn)
 
     # ----------------------------------------------------------- key rotation
     async def _install_new_key(self) -> Key:
@@ -349,10 +352,8 @@ class Core:
         LockBox discipline) returns one op or a list of ops derived from the
         live state; they are persisted and folded atomically with respect to
         other writers.  Returns the ops."""
-        if asyncio.iscoroutinefunction(build):
-            raise TypeError("update callbacks must be synchronous (LockBox)")
         async with self._apply_lock:
-            ops = build(self._data.state)
+            ops = LockBox(self._data.state).with_(build)
             if ops is None:
                 return []
             if not isinstance(ops, list):

@@ -1,0 +1,171 @@
+"""LockBox mechanism tests (utils/lockbox.py): the reference's
+compile-time no-await guarantee (crdt-enc/src/utils/mod.rs:165-195) as a
+runtime one — coroutine rejection, borrow revocation, escape detection —
+and its enforcement at the core's with_state/update entry points."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from crdt_enc_tpu.utils.lockbox import (
+    LockBox,
+    LockBoxViolation,
+    assert_outside_section,
+    in_section,
+)
+
+
+class Box:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+
+def test_sync_section_works():
+    lb = LockBox(Box())
+    assert lb.with_(lambda b: b.bump()) == 1
+    assert lb.with_(lambda b: b.n) == 1
+
+
+def test_rejects_coroutine_function():
+    lb = LockBox(Box())
+
+    async def bad(b):
+        return b.n
+
+    with pytest.raises(TypeError, match="synchronous"):
+        lb.with_(bad)
+
+
+def test_rejects_returned_awaitable():
+    lb = LockBox(Box())
+
+    def sneaky(b):
+        async def inner():
+            return b.n
+
+        return inner()
+
+    with pytest.raises(TypeError, match="suspendable"):
+        lb.with_(sneaky)
+
+
+def test_rejects_returned_generator():
+    lb = LockBox(Box())
+
+    def sneaky(b):
+        def gen():
+            yield b.n
+
+        return gen()
+
+    with pytest.raises(TypeError, match="suspendable"):
+        lb.with_(sneaky)
+
+
+def test_escaped_borrow_raises_on_use():
+    lb = LockBox(Box())
+    leaked = []
+    lb.with_(lambda b: leaked.append(b))
+    with pytest.raises(LockBoxViolation):
+        leaked[0].bump()
+    with pytest.raises(LockBoxViolation):
+        _ = leaked[0].n
+    with pytest.raises(LockBoxViolation):
+        leaked[0].n = 5
+
+
+def test_borrow_mutations_hit_real_value():
+    box = Box()
+    lb = LockBox(box)
+    lb.with_(lambda b: setattr(b, "n", 41))
+    assert box.n == 41
+    lb.with_(lambda b: b.bump())
+    assert box.n == 42
+
+
+def test_section_depth_and_guard():
+    lb = LockBox(Box())
+    assert not in_section()
+    seen = []
+    lb.with_(lambda b: seen.append(in_section()))
+    assert seen == [True]
+    assert not in_section()
+    assert_outside_section("test await")  # no raise outside
+
+    def inner(_b):
+        with pytest.raises(LockBoxViolation):
+            assert_outside_section("awaiting storage")
+
+    lb.with_(inner)
+
+
+def test_core_with_state_enforces(tmp_path):
+    from crdt_enc_tpu.backends.identity_crypto import IdentityCryptor
+    from crdt_enc_tpu.backends.memory import MemoryStorage
+    from crdt_enc_tpu.backends.plain_keys import PlainKeyCryptor
+    from crdt_enc_tpu.core.adapters import orset_adapter
+    from crdt_enc_tpu.core.core import Core, OpenOptions
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    async def run():
+        core = await Core.open(OpenOptions(
+            storage=MemoryStorage(),
+            cryptor=IdentityCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        ))
+        await core.update(lambda s: s.add_ctx(core.actor_id, b"x"))
+        assert core.with_state(lambda s: s.members()) == [b"x"]
+
+        async def bad(s):
+            return s.members()
+
+        with pytest.raises(TypeError):
+            core.with_state(bad)
+        with pytest.raises(TypeError):
+            await core.update(bad)
+
+        # the borrow must not survive the section
+        leak = []
+        core.with_state(lambda s: leak.append(s))
+        with pytest.raises(LockBoxViolation):
+            leak[0].members()
+
+    asyncio.run(run())
+
+
+def test_borrow_forwards_protocol_dunders():
+    class Seq:
+        def __init__(self):
+            self.items = [1, 2, 3]
+
+        def __len__(self):
+            return len(self.items)
+
+        def __iter__(self):
+            return iter(self.items)
+
+        def __contains__(self, x):
+            return x in self.items
+
+        def __getitem__(self, i):
+            return self.items[i]
+
+        def __eq__(self, other):
+            return isinstance(other, Seq) and self.items == other.items
+
+    lb = LockBox(Seq())
+    other = Seq()
+    out = lb.with_(
+        lambda s: (len(s), list(s), 2 in s, s[1], s == other, bool(s))
+    )
+    assert out == (3, [1, 2, 3], True, 2, True, True)
