@@ -219,5 +219,87 @@ def main():
         log(f"f32 4096^3 matmul: {t*1e3:.2f} ms ({2*4096**3/t/1e12:.0f} TFLOPs)")
 
 
+
+
+def pallas_sections(which):
+    """Round-3 additions: time the Pallas fold's XLA prologue (sort +
+    dedup + edges) separately from the full fold, to locate the wall."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import gen_columns
+    from crdt_enc_tpu.ops.pallas_fold import (
+        TILE_E, fold_cap, orset_fold_pallas,
+    )
+
+    dev = jax.devices()[0]
+    kind, member, actor, counter = gen_columns(N, R, E)
+    c0 = jax.device_put(np.zeros(R, np.int32), dev)
+    a0 = jax.device_put(np.zeros((E, R), np.int32), dev)
+    r0 = jax.device_put(np.zeros((E, R), np.int32), dev)
+    rows = [jax.device_put(x, dev) for x in (kind, member, actor, counter)]
+    tile_cap = fold_cap(member, E)
+
+    if "prologue" in which:
+        T = -(-E // TILE_E)
+
+        def mk(n):
+            @jax.jit
+            def run():
+                def body(carry, _):
+                    shift = carry % jnp.int32(N)
+                    k, m, a, c = (jnp.roll(x, shift) for x in rows)
+                    pad = a >= R
+                    a_ix = jnp.minimum(a, R - 1)
+                    is_add = (k == 0) & ~pad
+                    is_rm = (k == 1) & ~pad
+                    tile = m // TILE_E
+                    key = jnp.where(
+                        is_add | is_rm,
+                        (tile * 2 + is_rm) * (TILE_E * R)
+                        + (m - tile * TILE_E) * R + a_ix,
+                        T * 2 * TILE_E * R,
+                    )
+                    # cell-level replay gate lives in the kernel tail now
+                    gv = jnp.where(is_add | is_rm, c, 0)
+                    sk, sv = jax.lax.sort((key, gv), num_keys=2)
+                    nxt = jnp.concatenate([sk[1:], jnp.full((1,), -1, sk.dtype)])
+                    sv = jnp.where((sk != nxt), sv, 0)
+                    bounds = jnp.arange(2 * T + 1, dtype=jnp.int32) * (TILE_E * R)
+                    edges = jnp.searchsorted(sk, bounds).astype(jnp.int32)
+                    return edges[0] + sv[0], ()
+                out, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+                return out
+            return run
+
+        t = marginal(mk)
+        log(f"pallas prologue (sort+dedup+edges): {t*1e3:.2f} ms")
+
+    if "pallasfold" in which:
+        def mk(n):
+            @jax.jit
+            def run():
+                def body(carry, _):
+                    shift = (carry[0][0] + carry[1][0, 0]) % jnp.int32(N)
+                    k, m, a, c = (jnp.roll(x, shift) for x in rows)
+                    out = orset_fold_pallas(
+                        c0, a0, r0, k, m, a, c,
+                        num_members=E, num_replicas=R, tile_cap=tile_cap,
+                    )
+                    return out, ()
+                carry, _ = jax.lax.scan(
+                    body, (c0, a0, r0), None, length=n
+                )
+                return carry
+            return run
+
+        t = marginal(mk)
+        log(f"pallas full fold: {t*1e3:.2f} ms  ({N/t/1e6:.0f}M ops/s)")
+
+
 if __name__ == "__main__":
-    main()
+    which = set((os.environ.get("MB_WHICH") or "").split(","))
+    if which & {"prologue", "pallasfold"}:
+        pallas_sections(which)
+    else:
+        main()

@@ -195,8 +195,6 @@ def orset_fold_pallas(
     actor_ix = jnp.minimum(actor, R - 1)
     is_add = (kind == KIND_ADD) & ~pad
     is_rm = (kind == KIND_RM) & ~pad
-    seen = counter <= clock0[actor_ix]
-    live_add = is_add & ~seen
 
     tile = member // TILE_E
     m_local = member - tile * TILE_E
@@ -207,7 +205,7 @@ def orset_fold_pallas(
         (tile * 2 + plane) * (TILE_E * R) + m_local * R + actor_ix,
         sentinel,
     )
-    gval = jnp.where(live_add | is_rm, counter, 0)
+    gval = jnp.where(is_add | is_rm, counter, 0)
     skey, sval = jax.lax.sort((key, gval), num_keys=2)
     # last-of-run holds the segment max; zeroing the rest makes the
     # one-hot SUM equal the segment MAX (≤ one nonzero per cell)
@@ -274,7 +272,10 @@ def orset_fold_pallas(
     add_new = out_add.reshape(Ep, H * LANE)[:E, :R]
     rm_new = out_rm.reshape(Ep, H * LANE)[:E, :R]
 
-    # the orset_fold tail, verbatim semantics
+    # the orset_fold tail, verbatim semantics (cell-level replay gate:
+    # see the ops/orset.py fold — equivalent to row gating by per-actor
+    # dot monotonicity, without the 1M-row clock gather)
+    add_new = jnp.where(add_new > clock0[None, :], add_new, 0)
     clock = jnp.maximum(clock0, jnp.max(add_new, axis=0, initial=0))
     add = jnp.maximum(add0, add_new)
     rm = jnp.maximum(rm0, rm_new)

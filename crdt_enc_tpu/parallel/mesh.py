@@ -55,20 +55,21 @@ def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R):
     actor_ix = jnp.minimum(actor, R - 1)
     member_ix = jnp.clip(local_member, 0, E_local - 1)
 
-    seen = counter <= clock0[actor_ix]
-    live_add = is_add & ~seen
     seg = member_ix * R + actor_ix
     add_new = jax.ops.segment_max(
-        jnp.where(live_add, counter, 0), seg, num_segments=E_local * R
+        jnp.where(is_add, counter, 0), seg, num_segments=E_local * R
     )
     rm_new = jax.ops.segment_max(
         jnp.where(is_rm, counter, 0), seg, num_segments=E_local * R
     )
     add_new = jnp.maximum(add_new, 0).reshape(E_local, R)
     rm_new = jnp.maximum(rm_new, 0).reshape(E_local, R)
+    # cell-level replay gate (≡ row gating by per-actor dot monotonicity;
+    # see ops/orset.py) — avoids a per-row clock gather on every shard
+    add_new = jnp.where(add_new > clock0[None, :], add_new, 0)
     clock_new = jnp.maximum(
         jax.ops.segment_max(
-            jnp.where((kind == KIND_ADD) & ~pad & ~seen, counter, 0),
+            jnp.where((kind == KIND_ADD) & ~pad, counter, 0),
             actor_ix,
             num_segments=R,
         ),
