@@ -38,8 +38,13 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     return combine_orset_spans([part])
 
 
-def decode_orset_payload_spans(payloads: list, actors_sorted: list):
+def decode_orset_payload_spans(payloads, actors_sorted: list):
     """Native two-pass decode of one payload chunk to raw span columns.
+
+    ``payloads`` is a list of blob bytes, or a packed ``(buffer,
+    offsets)`` pair straight from ``decrypt_blobs_packed`` — the packed
+    form skips materializing and re-joining per-blob Python objects (at
+    100k-tiny-file scale that overhead dwarfed the decrypt itself).
 
     Returns ``(buf, kind, moff, mlen, actor, counter)`` — member values
     stay as (offset, length) spans into ``buf`` so chunks decoded at
@@ -47,7 +52,13 @@ def decode_orset_payload_spans(payloads: list, actors_sorted: list):
     (``combine_orset_spans``) — or None to request Python fallback.
     """
     lib = native.load()
-    if not payloads:
+    packed = isinstance(payloads, tuple)
+    if packed:
+        big, offs = payloads
+        n_payloads = len(offs) - 1
+    else:
+        n_payloads = len(payloads)
+    if n_payloads == 0:
         return (
             np.zeros(0, np.uint8),
             np.zeros(0, np.int8),
@@ -56,22 +67,25 @@ def decode_orset_payload_spans(payloads: list, actors_sorted: list):
             np.zeros(0, np.int32),
             np.zeros(0, np.int32),
         )
-    big = b"".join(payloads)
+    if packed:
+        bases = offs[:-1].astype(np.uint64, copy=True)
+        lens = np.diff(offs).astype(np.uint64)
+    else:
+        big = b"".join(payloads)
+        lens = np.array([len(p) for p in payloads], np.uint64)
+        bases = np.zeros(n_payloads, np.uint64)
+        np.cumsum(lens[:-1], out=bases[1:])
     buf = np.frombuffer(big, np.uint8)
     bp = buf.ctypes.data_as(native.u8p)
     actors_flat = b"".join(actors_sorted)
     ap, _a = native.in_ptr(actors_flat)
-
-    lens = np.array([len(p) for p in payloads], np.uint64)
-    bases = np.zeros(len(payloads), np.uint64)
-    np.cumsum(lens[:-1], out=bases[1:])
     basep = bases.ctypes.data_as(native.u64p)
     lenp = lens.ctypes.data_as(native.u64p)
 
     # pass 1: row counts (also validates framing) — one native call
-    counts = np.zeros(len(payloads), np.int64)
+    counts = np.zeros(n_payloads, np.int64)
     total = lib.orset_count_rows_batch(
-        bp, basep, lenp, len(payloads), counts.ctypes.data_as(_i64p)
+        bp, basep, lenp, n_payloads, counts.ctypes.data_as(_i64p)
     )
     if total < 0:
         return None
@@ -86,7 +100,7 @@ def decode_orset_payload_spans(payloads: list, actors_sorted: list):
 
     # pass 2: decode everything into consecutive row slices — one call
     got = lib.orset_decode_batch(
-        bp, basep, lenp, len(payloads), ap, len(actors_sorted),
+        bp, basep, lenp, n_payloads, ap, len(actors_sorted),
         counts.ctypes.data_as(_i64p),
         kind.ctypes.data_as(_i8p),
         moff.ctypes.data_as(native.u64p),
