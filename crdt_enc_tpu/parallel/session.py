@@ -519,11 +519,154 @@ class CounterFoldSession:
         return out
 
 
+class MapFoldSession:
+    """Chunked CrdtMap<orset> ingestion: each chunk decodes to the four
+    row families natively (validation up front — ``SessionDeclined``
+    fires at reduce time, never at finish) and interns its key/member
+    spans into running vocabularies; finish concatenates the remapped
+    families and runs the columnar map fold once against the state read
+    AT FINISH (``crdtmap_fold_host``), so applies that landed while
+    chunks were in flight are honored exactly like the whole-batch
+    path."""
+
+    def __init__(self, accel, state, actors_hint=()):
+        from ..ops.columnar import Vocab
+
+        self.accel = accel
+        self.state = state
+        actor_set = set(actors_hint)
+        actor_set.update(state.clock.counters)
+        for birth in state.births.values():
+            actor_set.update(birth)
+        for ctx, _rm_keys in state.deferred.values():
+            actor_set.update(ctx.counters)
+        for child in state.vals.values():
+            actor_set.update(child.clock.counters)
+            for entry in child.entries.values():
+                actor_set.update(entry)
+            for dfr in child.deferred.values():
+                actor_set.update(dfr)
+        self.actors_sorted = sorted(actor_set)
+        self.keys = Vocab()
+        self.members = Vocab()
+        self._fams: list = []  # (B, A, Rm, K) with vocab-global indices
+        self._n_groups = 0
+        self.rows_fed = 0
+        self._finished = False
+
+    def decode_chunk(self, payloads: list):
+        from ..ops.map_columnar import decode_map_payload_batch
+
+        decoded = decode_map_payload_batch(payloads, self.actors_sorted)
+        if decoded is None:
+            raise SessionDeclined("native map decoder declined the chunk")
+        return decoded
+
+    def _remap(self, vocab, objs):
+        """Chunk-local object table → running-vocab indices; declines on
+        a value collision (1 == True etc. — distinct canonical spans
+        interning to one slot would scatter rows onto the wrong row)."""
+        idx = np.fromiter(
+            (vocab.intern(o) for o in objs), np.int32, count=len(objs)
+        )
+        if len(objs) and len(np.unique(idx)) != len(objs):
+            raise SessionDeclined("vocab value collision in map chunk")
+        return idx
+
+    def reduce_chunk(self, decoded) -> None:
+        assert not self._finished, "session already finished"
+        B, A, Rm, Kk, key_objs, member_objs = decoded
+        kmap = self._remap(self.keys, key_objs)
+        mmap = self._remap(self.members, member_objs)
+
+        def rekey(fam, with_member):
+            out = dict(fam)
+            if len(fam["key"]):
+                out["key"] = kmap[fam["key"]]
+            if with_member and len(fam.get("member", ())):
+                out["member"] = mmap[fam["member"]]
+            return out
+
+        B2, A2, Rm2 = rekey(B, False), rekey(A, True), rekey(Rm, True)
+        K2 = rekey(Kk, False)
+        if len(K2["group"]):
+            K2["group"] = K2["group"] + self._n_groups
+            self._n_groups += int(Kk["group"].max()) + 1
+        self._fams.append((B2, A2, Rm2, K2))
+        self.rows_fed += (
+            len(B2["actor"]) + len(A2["actor"]) + len(Rm2["actor"])
+            + len(K2["actor"])
+        )
+
+    def feed(self, payloads: list) -> None:
+        self.reduce_chunk(self.decode_chunk(payloads))
+
+    def finish(self):
+        from ..ops.columnar import Vocab
+        from ..ops.map_columnar import crdtmap_fold_host
+
+        assert not self._finished, "session already finished"
+        self._finished = True
+        state = self.state
+        if not self._fams:
+            return state
+
+        def cat(ix, names):
+            return {
+                n: np.concatenate([f[ix].get(n, np.zeros(0, np.int32))
+                                   for f in self._fams])
+                for n in names
+            }
+
+        B = cat(0, ("key", "actor", "ctr"))
+        A = cat(1, ("key", "member", "actor", "ctr"))
+        Rm = cat(2, ("key", "member", "actor", "ctr", "mactor", "mctr"))
+        Kk = cat(3, ("key", "actor", "ctr", "group"))
+        self._fams = []
+        # concurrent applies may have introduced actors since open: the
+        # fed rows only ever index the original sorted prefix, so new
+        # actors intern AFTER it and the row indices stay valid
+        replicas = Vocab(self.actors_sorted)
+        state_actors = set(state.clock.counters)
+        for birth in state.births.values():
+            state_actors.update(birth)
+        for ctx, _rm_keys in state.deferred.values():
+            state_actors.update(ctx.counters)
+        for child in state.vals.values():
+            state_actors.update(child.clock.counters)
+            for entry in child.entries.values():
+                state_actors.update(entry)
+            for dfr in child.deferred.values():
+                state_actors.update(dfr)
+        for a in sorted(state_actors):
+            replicas.intern(a)
+        impl = self.accel.map_fold_impl
+        mesh_on = getattr(self.accel, "_mesh_active", lambda: False)()
+        if impl is None and mesh_on:
+            impl = "device"
+        elif impl is None:
+            impl = (
+                "device"
+                if self.rows_fed >= self.accel.min_device_batch
+                else "host"
+            )
+        crdtmap_fold_host(
+            state, B, A, Rm, Kk, self.keys, self.members,
+            replicas, fold_impl=impl,
+            mesh=self.accel.mesh if impl == "device" and mesh_on else None,
+        )
+        return state
+
+
 def open_fold_session(accel, state, actors_hint=()):
     """A fold session for ``state``, or None when no chunked columnar path
     exists for its type (the caller folds chunks through the per-op path)."""
+    from ..models.crdtmap import CrdtMap
+
     if isinstance(state, ORSet):
         return OrsetFoldSession(accel, state, actors_hint)
     if isinstance(state, (GCounter, PNCounter)):
         return CounterFoldSession(accel, state, actors_hint)
+    if isinstance(state, CrdtMap) and state.child == b"orset":
+        return MapFoldSession(accel, state, actors_hint)
     return None
