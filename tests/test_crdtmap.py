@@ -318,3 +318,63 @@ def test_true_concurrency_convergence():
         bulk = CrdtMap(child=b"orset")
         ok = accel.fold_payloads(bulk, payloads, actors_hint=ACTORS[:n_rep])
         assert ok and canonical_bytes(bulk) == finals[0], (trial, "bulk")
+
+
+def test_mvreg_child_impossibility_pinned():
+    """The pinned counterexample for why CHILD_TYPES excludes MVReg
+    (round-3 item 7: impossibility argument as a fixture, not prose).
+
+    Under this framework's transport a replica ingests both OP streams
+    (per-actor FIFO) and STATE snapshots (compaction files written at
+    arbitrary points).  A causal-map key-remove resets the child MVReg
+    (``reset_remove``), and snapshot merge uses clock dominance.  Those
+    two operations do not commute: merging a snapshot taken BEFORE a
+    remove into a state that already applied the remove resurrects the
+    removed dots (the stale pair's clock strictly dominates the reset
+    pair's), while the opposite order keeps the reset.  Same multiset of
+    operations, different final bytes — non-confluent, so no delivery
+    order the core can enforce (short of full causal broadcast, which
+    the file-sync transport cannot provide) makes an MVReg child
+    converge.  The ORSet child has no such collapse: its unit of state
+    is a per-(member, actor) dot maximum, which only grows under merge,
+    and removes are horizon maxima, not clock shrinkage.
+    """
+    import uuid
+
+    from crdt_enc_tpu.models import MVReg, canonical_bytes
+    from crdt_enc_tpu.models.vclock import VClock
+
+    A, B = uuid.UUID(int=1).bytes, uuid.UUID(int=2).bytes
+
+    def fresh():
+        # the child register as the map held it before the key-remove:
+        # one surviving write v2 whose causal basis includes A's dot
+        # (B wrote v2 after reading A's v1)
+        reg = MVReg()
+        reg.vals = [(VClock({A: 1, B: 1}), "v2")]
+        return reg
+
+    # the stale snapshot: a remote state file sealed BEFORE the remove
+    stale = fresh()
+
+    # replica X: key-remove fires (resetting ctx {A:1}), THEN the stale
+    # snapshot arrives and merges
+    x = fresh()
+    x.reset_remove(VClock({A: 1}))
+    assert x.vals == [(VClock({B: 1}), "v2")]  # reset applied
+    x.merge(stale)
+
+    # replica Y: the stale snapshot merges first (no-op — identical),
+    # THEN the same remove fires
+    y = fresh()
+    y.merge(stale)
+    y.reset_remove(VClock({A: 1}))
+
+    # Same operations, both orders legal under per-actor-FIFO + snapshot
+    # delivery — and they disagree: X resurrected the removed dot A:1.
+    assert canonical_bytes(x) != canonical_bytes(y), (
+        "if these ever converge, the MVReg-child exclusion in "
+        "models/crdtmap.py CHILD_TYPES should be revisited"
+    )
+    assert x.vals[0][0].get(A) == 1  # the dead dot is back at X
+    assert y.vals[0][0].get(A) == 0  # and gone at Y
