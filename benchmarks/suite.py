@@ -245,6 +245,30 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
 
     kind, member, actor, counter = north.gen_columns(N, R, E)
 
+    # the Pallas sorted one-hot-matmul fold when eligible (the north-star
+    # winner, see bench.py), else the fused XLA scatter
+    from crdt_enc_tpu.ops.pallas_fold import (
+        MAX_COUNTER, MAX_ROWS, fold_cap, orset_fold_pallas,
+    )
+
+    interpret = jax.default_backend() != "tpu"
+    use_pallas = counter.max() < MAX_COUNTER and N <= MAX_ROWS
+    if use_pallas:
+        tile_cap = fold_cap(member, E)
+
+        def fold(c, a, r, kind, member, actor, counter):
+            return orset_fold_pallas(
+                c, a, r, kind, member, actor, counter,
+                num_members=E, num_replicas=R, tile_cap=tile_cap,
+                interpret=interpret,
+            )
+    else:
+        def fold(c, a, r, kind, member, actor, counter):
+            return K.orset_fold(
+                c, a, r, kind, member, actor, counter,
+                num_members=E, num_replicas=R,
+            )
+
     n_chk = min(N, 20_000)
     h_state, _ = north.host_fold(
         kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk], R
@@ -252,9 +276,8 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
     c0 = np.zeros(R, np.int32)
     a0 = np.zeros((E, R), np.int32)
     r0 = np.zeros((E, R), np.int32)
-    ck, ad, rm = K.orset_fold(
-        c0, a0, r0, kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk],
-        num_members=E, num_replicas=R,
+    ck, ad, rm = fold(
+        c0, a0, r0, kind[:n_chk], member[:n_chk], actor[:n_chk], counter[:n_chk]
     )
     t_state = orset_planes_to_state(
         np.asarray(ck), np.asarray(ad), np.asarray(rm), Vocab(range(E)), Vocab(range(R))
@@ -267,16 +290,22 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
     args = [jax.device_put(x) for x in (c0, a0, r0, kind, member, actor, counter)]
 
     def make_chained(n):
+        import jax.numpy as jnp
+
         @jax.jit
         def run(c, a, r, kind, member, actor, counter):
+            # roll-anchored chain (see bench.py): fixed initial planes,
+            # carry-derived row permutation — every iteration does the
+            # full live-add workload and nothing can hoist
             def body(carry, _):
-                return (
-                    K.orset_fold(
-                        *carry, kind, member, actor, counter,
-                        num_members=E, num_replicas=R,
-                    ),
-                    (),
+                shift = (carry[0][0] + carry[1][0, 0]) % jnp.int32(
+                    kind.shape[0]
                 )
+                rolled = [
+                    jnp.roll(x, shift)
+                    for x in (kind, member, actor, counter)
+                ]
+                return fold(c, a, r, *rolled), ()
             carry, _ = jax.lax.scan(body, (c, a, r), None, length=n)
             return carry
         return lambda: run(*args)
