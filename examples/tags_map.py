@@ -1,0 +1,121 @@
+"""Encrypted shared tag index — a causal map of OR-Sets over a synced dir.
+
+Shows the catalogue's composite type (``CrdtMap<orset>``,
+models/crdtmap.py): each key holds a nested OR-Set of tags, a key
+remove deletes exactly the observed history (concurrent tag adds
+survive the remove — the same add-wins discipline as the flat set),
+and compaction folds the whole log through the columnar map fold.
+Replicas are devices sharing one ``remote`` directory synced by an
+external tool, the reference's replication model (README.md:3-11).
+
+    python examples/tags_map.py --data ./tags --local laptop tag inbox urgent
+    python examples/tags_map.py --data ./tags --local phone  tag inbox later
+    python examples/tags_map.py --data ./tags --local phone  list
+    python examples/tags_map.py --data ./tags --local laptop untag inbox urgent
+    python examples/tags_map.py --data ./tags --local laptop drop inbox
+    python examples/tags_map.py --data ./tags --local laptop compact
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from crdt_enc_tpu.backends import FsStorage, PassphraseKeyCryptor, XChaChaCryptor
+from crdt_enc_tpu.core import Core, OpenOptions, map_adapter
+from crdt_enc_tpu.models.orset import AddOp
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+async def open_replica(data_dir: str, local: str, passphrase: str) -> Core:
+    root = Path(data_dir)
+    return await Core.open(
+        OpenOptions(
+            storage=FsStorage(str(root / local), str(root / "remote")),
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PassphraseKeyCryptor(passphrase),
+            adapter=map_adapter(b"orset"),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        )
+    )
+
+
+async def run(args) -> int:
+    core = await open_replica(args.data, args.local, args.passphrase)
+    await core.read_remote()  # converge with whatever other devices wrote
+
+    if args.cmd == "tag":
+        key, tag = args.key, args.tag
+        await core.update(
+            lambda s: s.update_ctx(
+                core.actor_id, key, lambda child, dot: AddOp(tag, dot)
+            )
+        )
+        print(f"tagged {key!r} with {tag!r}")
+    elif args.cmd == "untag":
+        key, tag = args.key, args.tag
+
+        def build(s):
+            child = s.get(key)
+            if child is None or not child.contains(tag):
+                return None  # nothing observed to remove
+            return s.update_ctx(
+                core.actor_id, key, lambda c, dot: c.rm_ctx(tag)
+            )
+
+        ops = await core.update(build)
+        print(f"untagged {key!r}: {tag!r}" if ops else "nothing to untag")
+    elif args.cmd == "drop":
+        key = args.key
+
+        def build(s):
+            if not s.contains(key):
+                return None
+            return s.rm_ctx(key)
+
+        ops = await core.update(build)
+        print(f"dropped {key!r}" if ops else "no such key")
+    elif args.cmd == "list":
+        rows = core.with_state(
+            lambda s: {
+                k: sorted(str(t) for t in s.get(k).members())
+                for k in s.keys()
+            }
+        )
+        if not rows:
+            print("(empty)")
+        for k, tags in rows.items():
+            print(f"{k}: {', '.join(tags) or '(no tags)'}")
+    elif args.cmd == "compact":
+        await core.compact()
+        print(f"compacted; cursor {core.info().next_op_versions.to_obj()}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--local", required=True, help="this device's name")
+    ap.add_argument("--passphrase", default="hunter2")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("tag")
+    p.add_argument("key")
+    p.add_argument("tag")
+    p = sub.add_parser("untag")
+    p.add_argument("key")
+    p.add_argument("tag")
+    p = sub.add_parser("drop")
+    p.add_argument("key")
+    sub.add_parser("list")
+    sub.add_parser("compact")
+    return asyncio.run(run(ap.parse_args()))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
