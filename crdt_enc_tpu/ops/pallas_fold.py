@@ -65,7 +65,7 @@ from .columnar import KIND_ADD, KIND_RM
 
 TILE_E = 8  # members per tile (int32 sublane tile)
 LANE = 128
-SUB = 512  # rows per in-kernel matmul chunk
+SUB = 1024  # rows per in-kernel matmul chunk
 
 # 7-bit limb split keeps bf16 one-hot matmuls exact; counters must fit.
 MAX_COUNTER = 1 << 14

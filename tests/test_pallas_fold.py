@@ -118,8 +118,10 @@ def test_parity_exact_blk_multiple_with_empty_trailing_tile():
     # N an exact BLK multiple with the last tiles empty: the hi-window
     # block index of an empty trailing tile would point one past the
     # padded array without the clamp (review finding, round 3)
+    from crdt_enc_tpu.ops.pallas_fold import SUB
+
     E, R = 16, 8
-    N = 512  # == SUB == BLK for tile_cap=512
+    N = SUB  # == BLK exactly (fold_cap floor), the clamp's trigger shape
     rng = np.random.default_rng(12)
     kind = (rng.random(N) < 0.2).astype(np.int8)
     member = rng.integers(0, 8, N, dtype=np.int32)  # tiles 1.. empty
