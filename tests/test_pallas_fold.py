@@ -130,3 +130,38 @@ def test_parity_exact_blk_multiple_with_empty_trailing_tile():
     clock0 = np.zeros(R, np.int32)
     z = np.zeros((E, R), np.int32)
     _run_both(clock0, z, z, kind, member, actor, counter, E, R)
+
+
+# ---- property sweep ------------------------------------------------------
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    seed=st.integers(0, 2**16),
+    n=st.integers(1, 400),
+    e=st.integers(1, 48),
+    r=st.integers(1, 200),
+    rm_frac=st.floats(0.0, 1.0),
+    clocked=st.booleans(),
+)
+def test_parity_hypothesis(seed, n, e, r, rm_frac, clocked):
+    """Random shapes, skews, remove ratios, and starting clocks: the
+    Pallas fold must equal the XLA scatter fold everywhere."""
+    rng = np.random.default_rng(seed)
+    kind, member, actor, counter = _gen(
+        n, e, r, seed, max_counter=min(MAX_COUNTER, 400), rm_frac=rm_frac
+    )
+    clock0 = (
+        rng.integers(0, 60, r).astype(np.int32)
+        if clocked else np.zeros(r, np.int32)
+    )
+    add0 = np.zeros((e, r), np.int32)
+    rm0 = np.zeros((e, r), np.int32)
+    if clocked:
+        add0[rng.random((e, r)) < 0.08] = 50
+        rm0[rng.random((e, r)) < 0.04] = 35
+        add0 = np.where(add0 > rm0, add0, 0)
+        rm0 = np.where(rm0 > clock0[None, :], rm0, 0)
+    _run_both(clock0, add0, rm0, kind, member, actor, counter, e, r)
