@@ -27,9 +27,12 @@ BENCH_CHAIN (20).
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -37,6 +40,136 @@ import numpy as np
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+# Every successful run appends its full per-variant record here (committed),
+# so one capture-time tunnel outage cannot erase a round's perf evidence —
+# the round-3 failure mode (BENCH_r03.json: rc=1, parsed null, while the
+# kernel's numbers had been observed in-round with nothing persisted).
+LOCAL_LOG = os.path.join(REPO_ROOT, "BENCH_LOCAL.jsonl")
+
+
+def _append_local(rec: dict) -> None:
+    try:
+        line = json.dumps(rec)  # serialize before touching the file
+        with open(LOCAL_LOG, "a") as f:
+            f.write(line + "\n")
+    except (OSError, TypeError, ValueError) as e:
+        # never let bookkeeping kill a good run
+        log(f"WARNING: could not append {LOCAL_LOG}: {e!r}")
+
+
+def _last_good_local():
+    """Most recent successful record from BENCH_LOCAL.jsonl, or None."""
+    try:
+        with open(LOCAL_LOG) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        for ln in reversed(lines):
+            rec = json.loads(ln)
+            if rec.get("value") and rec.get("backend") == "tpu":
+                return rec
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _fail_unavailable(stage: str, attempts: list) -> "NoReturn":
+    """Distinguishable failure: ONE diagnostic JSON line on stdout (value
+    null, error field, probe history, last persisted good run) + exit 3.
+    Consumers can reconcile the null against BENCH_LOCAL.jsonl."""
+    print(json.dumps({
+        "metric": "orset_compaction_fold_ops_per_sec",
+        "value": None,
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "error": "tpu_backend_unavailable",
+        "stage": stage,
+        "attempts": attempts,
+        "last_good_local": _last_good_local(),
+    }), flush=True)
+    # os._exit: the hung backend-init thread (if any) must not block exit
+    os._exit(3)
+
+
+def acquire_jax(want_tpu: bool):
+    """Backend acquisition that cannot hang the bench.
+
+    Round 3 lost its perf artifact to exactly this: ``jax.devices()``
+    either failed fast with UNAVAILABLE or hung >9 minutes when the TPU
+    tunnel was down, and bench.py had no defense.  Strategy:
+
+    1. Probe backend init in a SUBPROCESS under a hard timeout
+       (``BENCH_INIT_TIMEOUT``, default 90s), with ``BENCH_INIT_ATTEMPTS``
+       retries (default 4) and ``BENCH_INIT_BACKOFF``s between (default
+       45) — a flaky tunnel gets several minutes to come back without any
+       risk of wedging this process.
+    2. Only then init in-process, with a watchdog thread that force-exits
+       (same diagnostic JSON, exit 3) if init exceeds 3× the timeout —
+       a probe success followed by an in-process hang still terminates.
+
+    When the caller doesn't expect a TPU (JAX_PLATFORMS=cpu — tests,
+    smoke runs), skip the probe entirely.
+    """
+    if not want_tpu:
+        import jax
+
+        return jax, jax.devices()[0]
+
+    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 90))
+    n_attempts = int(os.environ.get("BENCH_INIT_ATTEMPTS", 4))
+    backoff = float(os.environ.get("BENCH_INIT_BACKOFF", 45))
+    probe_src = (
+        "import jax; d = jax.devices()[0]; print(d.platform, d.device_kind)"
+    )
+    attempts = []
+    for i in range(n_attempts):
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe_src],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            out = r.stdout.strip()
+            if not out:
+                tail = r.stderr.strip().splitlines()
+                out = tail[-1] if tail else ""
+            rec = {
+                "rc": r.returncode,
+                "secs": round(time.perf_counter() - t0, 1),
+                "out": out[:200],
+            }
+        except subprocess.TimeoutExpired:
+            rec = {"rc": "timeout",
+                   "secs": round(time.perf_counter() - t0, 1), "out": ""}
+        attempts.append(rec)
+        ok = rec["rc"] == 0 and "tpu" in str(rec["out"]).lower()
+        log(f"backend probe {i + 1}/{n_attempts}: {rec}")
+        if ok:
+            break
+        if i + 1 < n_attempts:
+            time.sleep(backoff)
+    else:
+        _fail_unavailable("subprocess_probe", attempts)
+
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(3 * timeout):
+            log("in-process backend init exceeded watchdog; aborting")
+            _fail_unavailable("in_process_init_hang", attempts)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+    except Exception as e:  # fast UNAVAILABLE after a good probe (flap)
+        log(f"in-process backend init failed: {e!r}")
+        done.set()
+        _fail_unavailable("in_process_init_error", attempts)
+    done.set()
+    return jax, dev
 
 
 # Measured spread of tunnel round-trip jitter on this host (single source of
@@ -125,7 +258,13 @@ def main():
     N_HOST = min(N, int(os.environ.get("BENCH_HOST_OPS", 20_000 if smoke else 100_000)))
     ITERS = int(os.environ.get("BENCH_ITERS", 3))
 
-    import jax
+    # Expect a TPU unless the caller pinned a host-first platform list or
+    # is smoke-testing (a smoke run on a TPU-less box should fall through
+    # to CPU, not stall through 4 probe timeouts).
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    first_platform = platforms.split(",")[0].strip() if platforms else ""
+    want_tpu = first_platform not in ("cpu",) and not smoke
+    jax, dev = acquire_jax(want_tpu)
 
     import crdt_enc_tpu
     from crdt_enc_tpu import ops as K
@@ -133,7 +272,6 @@ def main():
     # compiles are excluded from the marginal timing, but the persistent
     # cache cuts the bench's own wall-clock on repeat runs
     crdt_enc_tpu.enable_compilation_cache()
-    dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}); N={N} R={R} E={E}")
 
     kind, member, actor, counter = gen_columns(N, R, E)
@@ -210,6 +348,68 @@ def main():
         del variant_kws[name]
     if not variant_kws:
         raise SystemExit("every fold variant diverged from the host reference")
+
+    # ---- full-batch byte equality: the PUBLISHED shape (all N rows), not
+    # just the 20k prefix — tile skew, the sliding windows, and the
+    # hi-limb skip only engage at scale.  Host truth at N=1M is the
+    # vectorized sparse host fold, itself tied to the per-op host
+    # reference on the subsample right here; the first variant is checked
+    # byte-for-byte through planes→state→pack, the rest plane-equal on
+    # device against it (equality is transitive, and one 300MB+ plane
+    # pull over the tunnel is enough).
+    full_checked = False
+    if os.environ.get("BENCH_FULL_CHECK", "1") == "1":
+        import jax.numpy as jnp
+
+        from crdt_enc_tpu.models import ORSet as HostORSet
+        from crdt_enc_tpu.ops.columnar import orset_fold_sparse_host
+
+        sub_sparse = orset_fold_sparse_host(
+            HostORSet(), kind[:n_chk], member[:n_chk], actor[:n_chk],
+            counter[:n_chk], mem_v, rep_v,
+        )
+        if codec.pack(sub_sparse.to_obj()) != h_bytes:
+            raise SystemExit(
+                "sparse host fold diverged from the per-op host reference "
+                "on the subsample — full-batch truth source is broken"
+            )
+        t0 = time.perf_counter()
+        full_host = orset_fold_sparse_host(
+            HostORSet(), kind, member, actor, counter, mem_v, rep_v
+        )
+        full_bytes = codec.pack(full_host.to_obj())
+        log(f"full-batch host fold (N={N}): {time.perf_counter() - t0:.2f}s")
+        full_args = [
+            jax.device_put(x, dev)
+            for x in (c0, a0, r0, kind, member, actor, counter)
+        ]
+        ref_planes = None
+        for name, kw in list(variant_kws.items()):
+            out = fold_call(kw)(*full_args)
+            jax.block_until_ready(out)
+            if ref_planes is None:
+                ck, ad, rmv = (np.asarray(x) for x in out)
+                st = orset_planes_to_state(ck, ad, rmv, mem_v, rep_v)
+                ok = codec.pack(st.to_obj()) == full_bytes
+                if ok:
+                    ref_planes = out
+            else:
+                ok = all(
+                    bool(jnp.array_equal(x, y))
+                    for x, y in zip(out, ref_planes)
+                )
+            log(
+                f"full-batch byte-equality[{name}] (N={N}): "
+                f"{'OK' if ok else 'MISMATCH'}"
+            )
+            if not ok:
+                log(f"WARNING: variant {name} diverged at the full batch; "
+                    "excluded")
+                del variant_kws[name]
+        if not variant_kws:
+            raise SystemExit("every variant diverged at the full batch")
+        del full_args, ref_planes
+        full_checked = True
 
     # ---- single-core host baseline (capped subsample; O(n) per-op loop)
     _, t_host = host_fold(kind[:N_HOST], member[:N_HOST], actor[:N_HOST], counter[:N_HOST], R)
@@ -320,7 +520,7 @@ def main():
     pct_hbm = roofline_pct(bytes_model, t_tpu, on_tpu)
     log(f"roofline: ≥{bytes_model/1e6:.0f}MB/fold → {pct_hbm}% of HBM peak")
 
-    print(json.dumps({
+    result = {
         "metric": "orset_compaction_fold_ops_per_sec",
         "value": round(tpu_rate, 1),
         "unit": "ops/s",
@@ -334,7 +534,32 @@ def main():
         # regressions and headroom visible mechanically (>100% = rejected)
         "bytes_model": bytes_model,
         "pct_hbm_peak": pct_hbm,
-    }))
+        # byte equality was checked at the full published shape, not just
+        # the subsample (VERDICT r3 item 4)
+        "full_batch_equal": full_checked,
+        "backend": dev.platform,
+    }
+    print(json.dumps(result))
+    # persist the run (full per-variant table) so a later capture-time
+    # tunnel outage cannot erase this round's verified numbers.  Only
+    # real-TPU runs go into the committed evidence file — CPU smoke runs
+    # would pollute it (override with BENCH_LOCAL_ALL=1 for testing).
+    if dev.platform != "tpu" and os.environ.get("BENCH_LOCAL_ALL") != "1":
+        return
+    _append_local({
+        **result,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "device_kind": dev.device_kind,
+        "shape": {"N": N, "R": R, "E": E, "chain": CHAIN, "iters": ITERS},
+        "host_rate": round(host_rate, 1),
+        "marginals_ms": {
+            k: round(v * 1e3, 3) for k, v in variants.items()
+        },
+        "single_dispatch_s": {
+            k: round(v, 4) for k, v in single_dispatch.items()
+        },
+    })
 
 
 if __name__ == "__main__":
