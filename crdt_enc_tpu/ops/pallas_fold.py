@@ -1,5 +1,5 @@
 """Pallas TPU kernel: the ORSet fold with the scatter reformulated as
-sorted one-hot matmuls on the MXU — the round-3 north-star attack.
+sorted one-hot matmuls on the MXU — the north-star attack (rounds 3-4).
 
 The dense fold (``ops/orset.py orset_fold``) spends its wall in XLA's
 scatter-max: random (member, actor) updates serialize at ~9ns/row
@@ -10,32 +10,43 @@ measured) and a fast *matmul*.  So this kernel restructures the scatter
 as dense linear algebra, the idiomatic TPU answer (the same move that
 turns embedding lookups into MXU work):
 
-1. **Sort** op rows by a tile-major segment key
-   ``(member-tile, plane, member%8, actor)`` with the gated counter as
-   a secondary sort key (one XLA bitonic sort, 2 operands).
+1. **Sort** op rows by a segment-major key with the gated counter as a
+   secondary sort key (one XLA bitonic sort, 2 operands).
 2. **Dedup**: after the sort the last row of every key-run holds that
    segment's max value; every other row's value is zeroed.  Each
    (member, actor) cell now receives AT MOST ONE nonzero value, so a
    *sum* equals the segment *max* — and a sum of one-hot rows is a
    matmul.
-3. **Bin** purely by index arithmetic: per-tile [start, mid, end) row
+3. **Bin** purely by index arithmetic: per-segment [start, end) row
    ranges from one searchsorted over the sorted keys.  No gather, no
-   per-tile padded copy (a round-2 prototype's gather cost more than
-   the scatter it replaced) — the kernel reads the sorted arrays in
-   place at SUB-aligned offsets and masks boundary rows by position; a
-   straddling chunk is visited by both neighbouring tiles, each keeping
-   only its own rows.
+   per-tile padded copy — the kernel reads the sorted arrays in place
+   at SUB-aligned offsets and masks boundary rows by position; a
+   straddling chunk is visited by both neighbouring segments, each
+   keeping only its own rows.
 4. **Pallas kernel**, grid over member tiles: each SUB-row chunk
-   becomes transposed one-hot matrices contracted on the MXU —
-   ``A_T (8H, SUB) = onehot(member%8 · H + actor//128)``,
-   ``B (128, SUB) = onehot(actor%128) · limb(value)`` — accumulating
-   the tile's ``(8, R)`` add/rm planes in VMEM, one HBM write per tile.
+   becomes transposed one-hot matrices contracted on the MXU,
+   accumulating the tile's planes in VMEM, one HBM write per tile.
    Values split into two 7-bit limbs so bf16 MXU passes are exact
    (limbs < 128 ≤ bf16's 8-bit mantissa); requires counters < 2^14
    (``MAX_COUNTER``), which the routing layer checks.
 5. The normalize tail (clock advance, ``add>rm`` masking, horizon
    retirement) is the same elementwise XLA pass as ``orset_fold`` —
    bandwidth-bound, fused by XLA.
+
+Two kernel layouts (``layout=``):
+
+- ``"ablk"`` (default, round 4): the segment key additionally blocks
+  the actor-hi dimension into ``H_BLK``-sized groups, so each chunk's
+  contraction is ``(8·H_BLK=128, SUB) × (SUB, 128) → (128, 128)`` —
+  a perfect MXU shape.  The wide layout's chunk contraction is
+  ``(8·H, SUB) × (SUB, 128)`` with ``H = R/128`` (632 rows at R=10k):
+  ~5× the FLOPs and one-hot build work for the same rows, which made
+  the matmul phase MXU-bound (~2.5-4ms of the 6.1ms round-3 fold).
+  The (128, 128) partial lands in the accumulator as 8 static
+  ``H_BLK``-row slice-adds (member-major accumulator rows keep the
+  final plane reshape free — a blocked-major layout would need a
+  328MB transpose at the end).
+- ``"wide"`` (round 3): kept for A/B measurement on hardware.
 
 Staleness (the replay gate against the incoming clock) is applied to the
 sorted *values*, not the keys: within a (member, actor, plane) run
@@ -45,8 +56,9 @@ independent of the carried clock, which keeps chained benchmark folds
 honest (no degenerate cheap iterations at the clock fixpoint).
 
 Byte-equality with ``orset_fold`` (and therefore with the host
-reference) is pinned by tests/test_pallas_fold.py; bench.py runs this
-as the ``pallas_bf16`` variant of the north-star config.
+reference) is pinned by tests/test_pallas_fold.py for both layouts;
+bench.py runs these as the ``pallas_bf16`` / ``pallas_wide`` variants
+of the north-star config.
 
 Reference analogue: the per-op hot loop at
 /root/reference/crdt-enc/src/lib.rs:533-539.
@@ -65,7 +77,8 @@ from .columnar import KIND_ADD, KIND_RM
 
 TILE_E = 8  # members per tile (int32 sublane tile)
 LANE = 128
-SUB = 1024  # rows per in-kernel matmul chunk
+SUB = 1024  # rows per in-kernel matmul chunk (wide layout)
+SUB_ABLK = 256  # rows per chunk (ablk layout: segments are smaller)
 
 # 7-bit limb split keeps bf16 one-hot matmuls exact; counters must fit.
 MAX_COUNTER = 1 << 14
@@ -73,7 +86,13 @@ MAX_COUNTER = 1 << 14
 MAX_ROWS = 1 << 22
 
 
-def _fold_tile_kernel(
+# --------------------------------------------------------------------------
+# wide layout (round 3): one segment per (tile, plane), chunk contraction
+# (8H, SUB) x (SUB, 128)
+# --------------------------------------------------------------------------
+
+
+def _fold_tile_kernel_wide(
     starts_ref, mids_ref, ends_ref,  # scalar prefetch: (T,) row ranges
     klo_ref, khi_ref, vlo_ref, vhi_ref,  # (1, BLK) windows of sorted rows
     out_add_ref, out_rm_ref,  # (1, 8H, 128) int32
@@ -160,36 +179,15 @@ def _fold_tile_kernel(
     static_argnames=("num_members", "num_replicas", "tile_cap", "retire_rm",
                      "dot_impl", "interpret"),
 )
-def orset_fold_pallas(
-    clock0: jax.Array,  # (R,) int32
-    add0: jax.Array,  # (E, R) int32
-    rm0: jax.Array,
-    kind: jax.Array,  # (N,) int8
-    member: jax.Array,  # (N,) int32
-    actor: jax.Array,  # (N,) int32  (== num_replicas ⇒ padding row)
-    counter: jax.Array,  # (N,) int32  (all < 2^14 — caller asserts)
-    *,
-    num_members: int,
-    num_replicas: int,
-    tile_cap: int = 1 << 14,  # ≥ max op rows in any 8-member tile (fold_cap)
-    retire_rm: bool = True,
-    dot_impl: str = "bf16",  # "bf16" (always exact ≤ 2^14); "int8" reserved
-    interpret: bool = False,
+def _fold_wide(
+    clock0, add0, rm0, kind, member, actor, counter,
+    *, num_members, num_replicas, tile_cap, retire_rm, dot_impl, interpret,
 ):
-    """Drop-in replacement for ``orset_fold`` (same contract, same
-    normalized output) with the scatter phase on the MXU.  Handles any
-    member-tile skew (loop bounds come from the sorted ranges, not a
-    padded per-tile capacity); batches beyond ``MAX_ROWS`` must be
-    chunked by the caller (the sorted columns are held in VMEM whole)."""
     E, R = num_members, num_replicas
     Ep = -(-E // TILE_E) * TILE_E
     T = Ep // TILE_E
     H = -(-R // LANE)
     N = kind.shape[0]
-    if N > MAX_ROWS:
-        raise ValueError(
-            f"batch of {N} rows exceeds MAX_ROWS={MAX_ROWS}; chunk it"
-        )
 
     pad = actor >= R
     actor_ix = jnp.minimum(actor, R - 1)
@@ -259,7 +257,7 @@ def orset_fold_pallas(
         ],
     )
     out_add, out_rm = pl.pallas_call(
-        partial(_fold_tile_kernel, H=H, R=R, BLK=BLK, dot_dtype=dot_dtype),
+        partial(_fold_tile_kernel_wide, H=H, R=R, BLK=BLK, dot_dtype=dot_dtype),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((T, TILE_E * H, LANE), jnp.int32),
@@ -271,10 +269,210 @@ def orset_fold_pallas(
     # (T, 8H, 128) row-major ≡ (Ep, H·128) row-major: free reshape
     add_new = out_add.reshape(Ep, H * LANE)[:E, :R]
     rm_new = out_rm.reshape(Ep, H * LANE)[:E, :R]
+    return _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm)
 
-    # the orset_fold tail, verbatim semantics (cell-level replay gate:
-    # see the ops/orset.py fold — equivalent to row gating by per-actor
-    # dot monotonicity, without the 1M-row clock gather)
+
+# --------------------------------------------------------------------------
+# ablk layout (round 4): segments block the actor-hi dimension so every
+# chunk contraction is (128, SUB) x (SUB, 128) — the native MXU shape
+# --------------------------------------------------------------------------
+
+
+def _fold_tile_kernel_ablk(
+    edges_ref,  # scalar prefetch: (n_segs+1,) segment row ranges
+    klo_ref, khi_ref, vlo_ref, vhi_ref,  # (1, BLK) windows of sorted rows
+    out_add_ref, out_rm_ref,  # (1, 8·Hp, 128) int32
+    *, Hp: int, H_BLK: int, A_BLK: int, BLK: int, SUBK: int, dot_dtype,
+):
+    t = pl.program_id(0)
+    nseg_t = 2 * A_BLK
+    base_seg = t * nseg_t
+    SEG = TILE_E * H_BLK * LANE  # key span of one segment
+    tile_start = edges_ref[base_seg]
+    w0 = (tile_start // BLK) * BLK
+
+    out_add_ref[...] = jnp.zeros(out_add_ref.shape, jnp.int32)
+    out_rm_ref[...] = jnp.zeros(out_rm_ref.shape, jnp.int32)
+
+    rows = TILE_E * H_BLK  # 128 when H_BLK=16: the MXU-native height
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, SUBK), 0)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, SUBK), 0)
+    pos_iota = jax.lax.broadcasted_iota(jnp.int32, (1, SUBK), 1)
+
+    acc_t = jnp.int32 if dot_dtype == jnp.int8 else jnp.float32
+    dims = (((1,), (1,)), ((), ()))  # contract the SUBK axis of both
+
+    def chunk(j, lo, hi, seg_base):
+        """Rows [j·SUBK, (j+1)·SUBK) of the sorted batch, masked to this
+        segment's [lo, hi) range: (rows, SUBK) × (SUBK, 128) limb
+        matmuls → a (rows, 128) partial.  Keys outside the segment
+        decode to a one-hot row outside [0, rows), zeroing their A_T
+        column; the position mask besides zeroes their value."""
+        off = pl.multiple_of(j * SUBK, SUBK)
+        local = off - w0
+        in_hi = local >= BLK
+        local = pl.multiple_of(jnp.where(in_hi, local - BLK, local), SUBK)
+
+        def load(ref_lo, ref_hi):
+            return jax.lax.cond(
+                in_hi,
+                lambda: ref_hi[0, pl.ds(local, SUBK)],
+                lambda: ref_lo[0, pl.ds(local, SUBK)],
+            ).reshape(1, SUBK)
+
+        k = load(klo_ref, khi_ref)
+        v = load(vlo_ref, vhi_ref)
+        pos = pos_iota + off
+        ok = (pos >= lo) & (pos < hi)
+        rel = k - seg_base  # = (m_local·H_BLK + a_hi_local)·128 + a_lo
+        row = jnp.where(ok, rel >> 7, -1)
+        a_lo = jnp.where(ok, rel & (LANE - 1), -1)
+        A_T = (row == row_iota).astype(dot_dtype)  # (rows, SUBK) 0/1
+        hot = a_lo == lane_iota  # (128, SUBK)
+        v_ok = jnp.where(ok, v, 0)
+        B_lo = hot * (v_ok & 127).astype(dot_dtype)
+        p_lo = jax.lax.dot_general(A_T, B_lo, dims, preferred_element_type=acc_t)
+
+        def with_hi(_):
+            p_hi = jax.lax.dot_general(
+                A_T, hot * (v_ok >> 7).astype(dot_dtype), dims,
+                preferred_element_type=acc_t,
+            )
+            return (p_hi.astype(jnp.int32) << 7) + p_lo.astype(jnp.int32)
+
+        return jax.lax.cond(
+            jnp.max(v_ok) >= 128, with_hi,
+            lambda _: p_lo.astype(jnp.int32), None,
+        )
+
+    # planes and actor-hi blocks are static → fully unrolled; only the
+    # chunk index inside each segment is a dynamic loop
+    for p, out_ref in ((0, out_add_ref), (1, out_rm_ref)):
+        for b in range(A_BLK):
+            s = base_seg + p * A_BLK + b
+            lo = edges_ref[s]
+            hi = edges_ref[s + 1]
+            seg_base = (t * nseg_t + p * A_BLK + b) * SEG
+
+            def body(j, _, lo=lo, hi=hi, seg_base=seg_base,
+                     out_ref=out_ref, b=b):
+                part = chunk(j, lo, hi, seg_base)
+                # scatter the (8·H_BLK, 128) partial into the
+                # member-major accumulator as 8 static slice-adds
+                for m in range(TILE_E):
+                    r0 = m * Hp + b * H_BLK
+                    out_ref[0, r0:r0 + H_BLK, :] += (
+                        part[m * H_BLK:(m + 1) * H_BLK, :]
+                    )
+                return 0
+
+            start_j = lo // SUBK
+            end_j = jnp.where(lo == hi, start_j, pl.cdiv(hi, SUBK))
+            jax.lax.fori_loop(start_j, end_j, body, 0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_members", "num_replicas", "tile_cap", "retire_rm",
+                     "dot_impl", "interpret", "sub_rows"),
+)
+def _fold_ablk(
+    clock0, add0, rm0, kind, member, actor, counter,
+    *, num_members, num_replicas, tile_cap, retire_rm, dot_impl, interpret,
+    sub_rows=SUB_ABLK,
+):
+    E, R = num_members, num_replicas
+    Ep = -(-E // TILE_E) * TILE_E
+    T = Ep // TILE_E
+    H = -(-R // LANE)
+    # actor-hi blocking: H_BLK=16 makes 8·H_BLK = 128 one-hot rows — the
+    # MXU-native matmul height.  Small R degenerates to one block.
+    H_BLK = 16 if H > 8 else 8
+    Hp = -(-H // H_BLK) * H_BLK
+    A_BLK = Hp // H_BLK
+    SEG = TILE_E * H_BLK * LANE
+    n_segs = 2 * T * A_BLK
+    N = kind.shape[0]
+
+    pad = actor >= R
+    actor_ix = jnp.minimum(actor, R - 1)
+    is_add = (kind == KIND_ADD) & ~pad
+    is_rm = (kind == KIND_RM) & ~pad
+
+    tile = member // TILE_E
+    m_local = member - tile * TILE_E
+    plane = is_rm.astype(jnp.int32)
+    a_hi = actor_ix // LANE
+    a_lo = actor_ix - a_hi * LANE
+    blk = a_hi // H_BLK
+    a_hil = a_hi - blk * H_BLK
+    seg_id = (tile * 2 + plane) * A_BLK + blk
+    within = (m_local * H_BLK + a_hil) * LANE + a_lo
+    sentinel = n_segs * SEG
+    key = jnp.where(is_add | is_rm, seg_id * SEG + within, sentinel)
+    gval = jnp.where(is_add | is_rm, counter, 0)
+    skey, sval = jax.lax.sort((key, gval), num_keys=2)
+    nxt = jnp.concatenate([skey[1:], jnp.full((1,), -1, skey.dtype)])
+    sval = jnp.where((skey != nxt) & (skey < sentinel), sval, 0)
+
+    # per-segment [start, end): one searchsorted over segment bounds
+    bounds = jnp.arange(n_segs + 1, dtype=jnp.int32) * SEG
+    edges = jnp.searchsorted(skey, bounds).astype(jnp.int32)
+
+    BLK = sub_rows
+    while BLK < tile_cap:
+        BLK *= 2
+    Np = (-(-N // BLK) + 1) * BLK
+    skey = jnp.concatenate([skey, jnp.full((Np - N,), sentinel, jnp.int32)])
+    sval = jnp.concatenate([sval, jnp.zeros((Np - N,), jnp.int32)])
+    skey = skey.reshape(1, Np)
+    sval = sval.reshape(1, Np)
+
+    dot_dtype = jnp.int8 if dot_impl == "int8" else jnp.bfloat16
+    nseg_t = 2 * A_BLK
+    win_lo = pl.BlockSpec(
+        (1, BLK), lambda t, e: (0, e[t * nseg_t] // BLK),
+        memory_space=pltpu.VMEM,
+    )
+    last_blk = Np // BLK - 1
+    win_hi = pl.BlockSpec(
+        (1, BLK),
+        lambda t, e: (0, jnp.minimum(e[t * nseg_t] // BLK + 1, last_blk)),
+        memory_space=pltpu.VMEM,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T,),
+        in_specs=[win_lo, win_hi, win_lo, win_hi],
+        out_specs=[
+            pl.BlockSpec((1, TILE_E * Hp, LANE), lambda t, e: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_E * Hp, LANE), lambda t, e: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    out_add, out_rm = pl.pallas_call(
+        partial(_fold_tile_kernel_ablk, Hp=Hp, H_BLK=H_BLK, A_BLK=A_BLK,
+                BLK=BLK, SUBK=sub_rows, dot_dtype=dot_dtype),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((T, TILE_E * Hp, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((T, TILE_E * Hp, LANE), jnp.int32),
+        ],
+        interpret=interpret,
+    )(edges, skey, skey, sval, sval)
+
+    # accumulator rows are member-major (m_local·Hp + a_hi), so
+    # (T, 8·Hp, 128) row-major ≡ (Ep, Hp·128) row-major: free reshape
+    add_new = out_add.reshape(Ep, Hp * LANE)[:E, :R]
+    rm_new = out_rm.reshape(Ep, Hp * LANE)[:E, :R]
+    return _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm)
+
+
+def _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm):
+    """The orset_fold tail, verbatim semantics (cell-level replay gate:
+    see the ops/orset.py fold — equivalent to row gating by per-actor
+    dot monotonicity, without the 1M-row clock gather)."""
     add_new = jnp.where(add_new > clock0[None, :], add_new, 0)
     clock = jnp.maximum(clock0, jnp.max(add_new, axis=0, initial=0))
     add = jnp.maximum(add0, add_new)
@@ -283,6 +481,53 @@ def orset_fold_pallas(
     if retire_rm:
         rm = jnp.where(rm > clock[None, :], rm, 0)
     return clock, add, rm
+
+
+def orset_fold_pallas(
+    clock0: jax.Array,  # (R,) int32
+    add0: jax.Array,  # (E, R) int32
+    rm0: jax.Array,
+    kind: jax.Array,  # (N,) int8
+    member: jax.Array,  # (N,) int32
+    actor: jax.Array,  # (N,) int32  (== num_replicas ⇒ padding row)
+    counter: jax.Array,  # (N,) int32  (all < 2^14 — caller asserts)
+    *,
+    num_members: int,
+    num_replicas: int,
+    tile_cap: int = 1 << 14,  # ≥ max op rows in any 8-member tile (fold_cap)
+    retire_rm: bool = True,
+    dot_impl: str = "bf16",  # "bf16" (always exact ≤ 2^14); "int8" reserved
+    interpret: bool = False,
+    layout: str = "ablk",  # "ablk" (round 4, default) | "wide" (round 3)
+):
+    """Drop-in replacement for ``orset_fold`` (same contract, same
+    normalized output) with the scatter phase on the MXU.  Handles any
+    member-tile skew (loop bounds come from the sorted ranges, not a
+    padded per-tile capacity); batches beyond ``MAX_ROWS`` must be
+    chunked by the caller (the sorted columns are held in VMEM whole)."""
+    E, R = num_members, num_replicas
+    N = kind.shape[0]
+    if N > MAX_ROWS:
+        raise ValueError(
+            f"batch of {N} rows exceeds MAX_ROWS={MAX_ROWS}; chunk it"
+        )
+    Ep = -(-E // TILE_E) * TILE_E
+    # both layouts' key spaces are ~2·Ep·(R padded): guard int32
+    H = -(-R // LANE)
+    H_BLK = 16 if H > 8 else 8
+    Hp = -(-H // H_BLK) * H_BLK
+    if layout == "ablk" and 2 * Ep * Hp * LANE >= 2 ** 31:
+        layout = "wide"  # tighter padding; its own guard below
+    if (Ep // TILE_E) * (2 * TILE_E * R) + 2 * TILE_E * R >= 2 ** 31:
+        raise ValueError("E·R too large for int32 segment keys; shard first")
+    kw = dict(
+        num_members=E, num_replicas=R, tile_cap=tile_cap,
+        retire_rm=retire_rm, dot_impl=dot_impl, interpret=interpret,
+    )
+    args = (clock0, add0, rm0, kind, member, actor, counter)
+    if layout == "wide":
+        return _fold_wide(*args, **kw)
+    return _fold_ablk(*args, **kw)
 
 
 def fold_cap(member, num_members: int) -> int:

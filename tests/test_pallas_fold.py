@@ -27,21 +27,23 @@ def _gen(N, E, R, seed, max_counter=200, rm_frac=0.3, pad_frac=0.05):
     return kind, member, actor, counter
 
 
-def _run_both(clock0, add0, rm0, kind, member, actor, counter, E, R, **kw):
+def _run_both(clock0, add0, rm0, kind, member, actor, counter, E, R,
+              layouts=("ablk", "wide"), **kw):
     ref = K.orset_fold(
         clock0, add0, rm0, kind, member, actor, counter,
         num_members=E, num_replicas=R,
         retire_rm=kw.get("retire_rm", True),
     )
-    got = orset_fold_pallas(
-        clock0, add0, rm0, kind, member, actor, counter,
-        num_members=E, num_replicas=R, tile_cap=fold_cap(member, E),
-        interpret=True, **kw,
-    )
-    for r, g, name in zip(ref, got, ("clock", "add", "rm")):
-        np.testing.assert_array_equal(
-            np.asarray(r), np.asarray(g), err_msg=name
+    for layout in layouts:
+        got = orset_fold_pallas(
+            clock0, add0, rm0, kind, member, actor, counter,
+            num_members=E, num_replicas=R, tile_cap=fold_cap(member, E),
+            interpret=True, layout=layout, **kw,
         )
+        for r, g, name in zip(ref, got, ("clock", "add", "rm")):
+            np.testing.assert_array_equal(
+                np.asarray(r), np.asarray(g), err_msg=f"{layout}:{name}"
+            )
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -128,6 +130,28 @@ def test_parity_exact_blk_multiple_with_empty_trailing_tile():
     actor = rng.integers(0, R, N, dtype=np.int32)
     counter = rng.integers(1, 300, N, dtype=np.int32)
     clock0 = np.zeros(R, np.int32)
+    z = np.zeros((E, R), np.int32)
+    _run_both(clock0, z, z, kind, member, actor, counter, E, R)
+
+
+@pytest.mark.parametrize(
+    "R",
+    [
+        1200,  # H=10 → H_BLK=16, Hp=16, A_BLK=1 (padded hi rows)
+        2500,  # H=20 → Hp=32, A_BLK=2: multi actor-block segments
+    ],
+)
+def test_parity_large_R_actor_blocks(R):
+    # the ablk layout's actor-hi blocking only engages above R=1024
+    # (H_BLK=16) and splits into multiple blocks above R=2048 — regimes
+    # the small parity shapes never reach
+    E, N = 24, 900
+    rng = np.random.default_rng(21)
+    kind = (rng.random(N) < 0.25).astype(np.int8)
+    member = rng.integers(0, E, N, dtype=np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = rng.integers(1, 500, N, dtype=np.int32)
+    clock0 = rng.integers(0, 40, R).astype(np.int32)
     z = np.zeros((E, R), np.int32)
     _run_both(clock0, z, z, kind, member, actor, counter, E, R)
 
