@@ -18,6 +18,37 @@ u8p = ctypes.POINTER(ctypes.c_uint8)
 u64p = ctypes.POINTER(ctypes.c_uint64)
 
 
+def _build_and_load(target: str, so_path: str, dll_cls, bind_fn):
+    """Build one make target under the shared file lock and dlopen it.
+
+    Always invokes make: an incremental no-op when fresh, and source
+    edits never silently run stale native code.  The file lock
+    serializes concurrent processes (the in-process _lock can't) so one
+    never dlopens a half-linked .so.  Building only the requested
+    target keeps the libraries independent — e.g. a box without CPython
+    dev headers still gets the header-free crypto/codec library even
+    though the C-API state library cannot compile there.
+    """
+    os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)
+    with open(os.path.join(_HERE, "build", ".lock"), "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        try:
+            subprocess.run(
+                ["make", "-C", _HERE, target],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed (exit {e.returncode}):\n"
+                f"{e.stdout}\n{e.stderr}"
+            ) from e
+        lib = dll_cls(so_path)
+    bind_fn(lib)
+    return lib
+
+
 def load() -> ctypes.CDLL:
     global _lib, _load_error
     with _lock:
@@ -28,28 +59,10 @@ def load() -> ctypes.CDLL:
             # paths (e.g. the fs op scan) probe per call and must not spawn
             # a failing `make` subprocess every time
             raise _load_error
-        # always invoke make: an incremental no-op when fresh, and source
-        # edits never silently run stale native code.  A file lock serializes
-        # concurrent processes (the in-process _lock can't) so one never
-        # dlopens a half-linked .so.
         try:
-            os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)
-            with open(os.path.join(_HERE, "build", ".lock"), "w") as lk:
-                fcntl.flock(lk, fcntl.LOCK_EX)
-                try:
-                    subprocess.run(
-                        ["make", "-C", _HERE],
-                        check=True,
-                        capture_output=True,
-                        text=True,
-                    )
-                except subprocess.CalledProcessError as e:
-                    raise RuntimeError(
-                        f"native build failed (exit {e.returncode}):\n"
-                        f"{e.stdout}\n{e.stderr}"
-                    ) from e
-                lib = ctypes.CDLL(_SO)
-            _bind(lib)
+            lib = _build_and_load(
+                "build/libcrdtnative.so", _SO, ctypes.CDLL, _bind
+            )
         except Exception as e:
             # cache ANY load failure (build, dlopen, missing symbol): hot
             # paths probe per call and must never re-spawn make
@@ -58,6 +71,49 @@ def load() -> ctypes.CDLL:
 
         _lib = lib
         return lib
+
+
+_STATE_SO = os.path.join(_HERE, "build", "libcrdtstate.so")
+_state_lib: ctypes.PyDLL | None = None
+_state_error: Exception | None = None
+
+
+def load_state() -> ctypes.PyDLL:
+    """The C-API state-assembly library (statebuild.cpp).
+
+    Loaded with ``PyDLL`` — calls hold the GIL because the functions
+    create Python objects (dicts of a folded state).  Separate from the
+    CDLL crypto/codec library, whose calls release the GIL.  Same
+    build-on-demand + cached-failure discipline as ``load()``.
+    """
+    global _state_lib, _state_error
+    with _lock:
+        if _state_lib is not None:
+            return _state_lib
+        if _state_error is not None:
+            raise _state_error
+        try:
+            lib = _build_and_load(
+                "build/libcrdtstate.so", _STATE_SO, ctypes.PyDLL, _bind_state
+            )
+        except Exception as e:
+            _state_error = e
+            raise
+        _state_lib = lib
+        return lib
+
+
+def _bind_state(lib) -> None:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.orset_fresh_fold.argtypes = [
+        ctypes.POINTER(ctypes.c_int8), i32p, i32p, i32p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, i32p,
+        ctypes.py_object, ctypes.py_object,
+        ctypes.py_object, ctypes.py_object,
+    ]
+    lib.orset_fresh_fold.restype = ctypes.c_int
+    lib.dense_clock_dict.argtypes = [i32p, ctypes.c_int64, ctypes.py_object]
+    lib.dense_clock_dict.restype = ctypes.py_object
 
 
 def _bind(lib) -> None:
@@ -128,6 +184,18 @@ def _bind(lib) -> None:
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
     ]
     lib.orset_decode_batch.restype = ctypes.c_int64
+    lib.actor_hash_build.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_uint64,
+    ]
+    lib.actor_hash_build.restype = None
+    lib.orset_decode_batch_h.argtypes = [
+        u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64, i64p,
+        ctypes.POINTER(ctypes.c_int8), u64p, u64p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.orset_decode_batch_h.restype = ctypes.c_int64
     lib.counter_decode_batch.argtypes = [
         u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_int8),

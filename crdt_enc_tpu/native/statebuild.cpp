@@ -1,0 +1,259 @@
+// Native sparse-state assembly for the streaming ORSet fold.
+//
+// The round-3 streaming pipeline (BASELINE config 5) ended in Python:
+// numpy lexsort over segment keys (~48ms/200k rows on this host) plus
+// per-member dict construction (~105ms) — the last non-columnar link in
+// an otherwise native decrypt→decode→fold chain, and the measured wall
+// at the 100k-replica scale.  This file moves that tail into C++:
+//
+//  * a packed-u64 LSD radix sort ((segment_key)·(maxc+1) + counter), so
+//    "last of run holds the segment max" falls out of the sort order;
+//  * the fresh-state writeback (the streaming shape: one combined fold
+//    into an empty state) building the member→{actor: counter} dicts
+//    directly through the CPython C-API.
+//
+// Semantics are exactly ops/columnar.py orset_fold_sparse_host +
+// orset_apply_coo's fresh path (strict > horizon for adds, removes kept
+// only above the merged clock); byte equality is pinned by the sparse
+// fold tests plus bench.py's full-batch check.  Non-fresh states
+// (pre-existing entries/deferred) stay on the Python path.
+//
+// This .so is loaded with ctypes.PyDLL (GIL held) because it creates
+// Python objects; the compute sections are a few ms and this box is
+// single-core, so holding the GIL costs nothing.
+//
+// Reference analogue: the consumer path crdt-enc/src/lib.rs:471-547 at
+// 100k-replica streaming scale.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+// Presized dict creation skips the grow/rehash cascade while filling
+// (the member dicts average ~166 entries at the config-5 shape and the
+// clock dict holds one entry per replica).  _PyDict_NewPresized is a
+// private-but-exported CPython symbol (msgpack's C extension uses it
+// the same way); weak-linked so a build against a Python that drops it
+// falls back to PyDict_New.
+extern "C" PyObject* _PyDict_NewPresized(Py_ssize_t minused)
+    __attribute__((weak));
+
+namespace {
+
+PyObject* new_dict_presized(Py_ssize_t n) {
+    if (_PyDict_NewPresized != nullptr && n > 5)
+        return _PyDict_NewPresized(n);
+    return PyDict_New();
+}
+
+// LSD radix sort of uint64 values, 8-bit digits, skipping passes whose
+// digit is constant across the array (high zero bytes of small keys).
+void radix_sort_u64(std::vector<uint64_t>& a, uint64_t maxval) {
+    if (a.size() < 2) return;
+    std::vector<uint64_t> tmp(a.size());
+    uint64_t* src = a.data();
+    uint64_t* dst = tmp.data();
+    bool in_tmp = false;
+    for (int pass = 0; pass < 8; ++pass) {
+        const int shift = pass * 8;
+        if ((maxval >> shift) == 0) break;  // no set bits at/after this byte
+        size_t hist[256] = {0};
+        const size_t n = a.size();
+        for (size_t i = 0; i < n; ++i) hist[(src[i] >> shift) & 0xff]++;
+        if (hist[(src[0] >> shift) & 0xff] == n) continue;  // constant digit
+        size_t sum = 0;
+        for (int b = 0; b < 256; ++b) {
+            size_t c = hist[b];
+            hist[b] = sum;
+            sum += c;
+        }
+        for (size_t i = 0; i < n; ++i)
+            dst[hist[(src[i] >> shift) & 0xff]++] = src[i];
+        std::swap(src, dst);
+        in_tmp = !in_tmp;
+    }
+    if (in_tmp) std::memcpy(a.data(), src, a.size() * sizeof(uint64_t));
+}
+
+// Dedup a sorted packed array (key = p / M, val = p % M) into (seg, val)
+// arrays keeping the last (= max val) entry of every key run.
+void dedup(const std::vector<uint64_t>& packed, uint64_t M,
+           std::vector<int64_t>& seg, std::vector<int64_t>& val) {
+    const size_t n = packed.size();
+    seg.reserve(n);
+    val.reserve(n);
+    for (size_t i = 0; i < n; ++i) {
+        if (i + 1 < n && packed[i] / M == packed[i + 1] / M) continue;
+        seg.push_back((int64_t)(packed[i] / M));
+        val.push_back((int64_t)(packed[i] % M));
+    }
+}
+
+// Emit consecutive same-member groups of (seg, val) rows into
+// target[member_obj] = {actor_obj: val}.  Rows are member-major because
+// seg = member·R + actor and the arrays are sorted.
+// Returns 0 ok, -1 on a Python error (exception set).
+int emit_groups(PyObject* target, PyObject* member_objs, PyObject* actor_objs,
+                int64_t R, const std::vector<int64_t>& seg,
+                const std::vector<int64_t>& val) {
+    const size_t n = seg.size();
+    size_t s = 0;
+    while (s < n) {
+        const int64_t m = seg[s] / R;
+        size_t e = s + 1;
+        while (e < n && seg[e] / R == m) ++e;
+        PyObject* d = new_dict_presized((Py_ssize_t)(e - s));
+        if (!d) return -1;
+        for (size_t i = s; i < e; ++i) {
+            PyObject* a = PyList_GET_ITEM(actor_objs, (Py_ssize_t)(seg[i] % R));
+            PyObject* c = PyLong_FromLongLong((long long)val[i]);
+            if (!c || PyDict_SetItem(d, a, c) < 0) {
+                Py_XDECREF(c);
+                Py_DECREF(d);
+                return -1;
+            }
+            Py_DECREF(c);
+        }
+        if (PyDict_SetItem(target, PyList_GET_ITEM(member_objs, (Py_ssize_t)m),
+                           d) < 0) {
+            Py_DECREF(d);
+            return -1;
+        }
+        Py_DECREF(d);
+        s = e;
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fold a raw (kind, member, actor, counter) op batch into an EMPTY
+// ORSet's entries/deferred dicts + dense clock.
+//
+//  kind:    (n,) int8   0=add 1=remove (anything else ignored)
+//  member:  (n,) int32  vocab index < E
+//  actor:   (n,) int32  vocab index; >= R marks a padding row
+//  counter: (n,) int32  dot counter / horizon
+//  clock:   (R,) int32  in-out: the state's dense clock, merged in place
+//  member_objs / actor_objs: vocab object lists (len E / R)
+//  entries / deferred: empty dicts to fill (member -> {actor: counter})
+//
+// Returns 0 on success, -1 if the shape overflows the packed-key sort
+// (caller must use the Python path), -2 on a Python error.
+int orset_fresh_fold(const int8_t* kind, const int32_t* member,
+                     const int32_t* actor, const int32_t* counter, int64_t n,
+                     int64_t E, int64_t R, int32_t* clock,
+                     PyObject* member_objs, PyObject* actor_objs,
+                     PyObject* entries, PyObject* deferred) {
+    // pass 0: max counter over participating rows (packing modulus)
+    int64_t maxc = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (actor[i] >= R) continue;
+        if (counter[i] > maxc) maxc = counter[i];
+    }
+    const uint64_t M = (uint64_t)maxc + 1;
+    const uint64_t segspace = (uint64_t)E * (uint64_t)R;
+    // overflow guard: packed = seg·M + c with seg < segspace must fit
+    // u64 comfortably (two sides sorted separately, so no 2x factor)
+    if (segspace != 0 && M > (((uint64_t)1 << 62) / (segspace + 1))) return -1;
+
+    // pass 1: gate + pack into separate add/remove arrays.  Add rows
+    // gate against the ORIGINAL clock (copy) while the merged clock
+    // updates in place — same order of effects as the numpy path
+    // (np.maximum.at over live adds, then the remove filter sees the
+    // merged clock).
+    std::vector<int32_t> clock0(clock, clock + (size_t)R);
+    std::vector<uint64_t> adds, rms;
+    adds.reserve((size_t)n);
+    for (int64_t i = 0; i < n; ++i) {
+        const int32_t a = actor[i];
+        if (a < 0 || a >= R) continue;
+        const int64_t c = counter[i];
+        if (c < 0) continue;  // defensive: counters are non-negative
+        const uint64_t seg = (uint64_t)member[i] * (uint64_t)R + (uint64_t)a;
+        if (kind[i] == 0) {
+            if (c > clock0[a]) {  // replay gate vs the incoming clock
+                adds.push_back(seg * M + (uint64_t)c);
+                if (c > clock[a]) clock[a] = (int32_t)c;  // merged clock
+            }
+        } else if (kind[i] == 1) {
+            rms.push_back(seg * M + (uint64_t)c);
+        }
+    }
+    const uint64_t maxpacked = segspace == 0 ? 0 : (segspace - 1) * M + maxc;
+    radix_sort_u64(adds, maxpacked);
+    radix_sort_u64(rms, maxpacked);
+
+    std::vector<int64_t> aseg, aval, rseg, rval;
+    dedup(adds, M, aseg, aval);
+    dedup(rms, M, rseg, rval);
+
+    // adds survive a STRICTLY greater horizon on their own segment
+    // (equal horizon observed the dot — it dies); merge-join on the
+    // sorted segs
+    {
+        size_t keep = 0, r = 0;
+        for (size_t i = 0; i < aseg.size(); ++i) {
+            while (r < rseg.size() && rseg[r] < aseg[i]) ++r;
+            const int64_t horizon =
+                (r < rseg.size() && rseg[r] == aseg[i]) ? rval[r] : 0;
+            if (aval[i] > horizon) {
+                aseg[keep] = aseg[i];
+                aval[keep] = aval[i];
+                ++keep;
+            }
+        }
+        aseg.resize(keep);
+        aval.resize(keep);
+    }
+    // removes survive only above the MERGED clock
+    {
+        size_t keep = 0;
+        for (size_t i = 0; i < rseg.size(); ++i) {
+            if (rval[i] > clock[rseg[i] % R]) {
+                rseg[keep] = rseg[i];
+                rval[keep] = rval[i];
+                ++keep;
+            }
+        }
+        rseg.resize(keep);
+        rval.resize(keep);
+    }
+
+    if (emit_groups(entries, member_objs, actor_objs, R, aseg, aval) < 0)
+        return -2;
+    if (emit_groups(deferred, member_objs, actor_objs, R, rseg, rval) < 0)
+        return -2;
+    return 0;
+}
+
+// Build {actor_obj: counter} for the nonzero entries of a dense clock —
+// the native twin of ops/columnar.py dense_to_vclock's dict body.
+// Returns a NEW dict, or NULL on error.
+PyObject* dense_clock_dict(const int32_t* clock, int64_t R,
+                           PyObject* actor_objs) {
+    int64_t nz = 0;
+    for (int64_t i = 0; i < R; ++i) nz += (clock[i] != 0);
+    PyObject* d = new_dict_presized((Py_ssize_t)nz);
+    if (!d) return nullptr;
+    for (int64_t i = 0; i < R; ++i) {
+        if (clock[i] == 0) continue;
+        PyObject* c = PyLong_FromLong((long)clock[i]);
+        if (!c ||
+            PyDict_SetItem(d, PyList_GET_ITEM(actor_objs, (Py_ssize_t)i), c) <
+                0) {
+            Py_XDECREF(c);
+            Py_DECREF(d);
+            return nullptr;
+        }
+        Py_DECREF(c);
+    }
+    return d;
+}
+
+}  // extern "C"

@@ -51,23 +51,44 @@ def pad_orset_rows(cols: "OrsetColumns", target: int, num_replicas: int):
 class Vocab:
     """Interning table: object → dense index (first-appearance order)."""
 
+    __slots__ = ("items", "_index")
+
     def __init__(self, items=()):
         items = list(items)
         index = dict(zip(items, range(len(items))))
         if len(index) == len(items):  # no duplicates: one bulk dict build
-            self.index: dict = index
+            self._index: dict | None = index
             self.items: list = items
         else:
-            self.index = {}
+            self._index = {}
             self.items = []
             for it in items:
                 self.intern(it)
 
+    @classmethod
+    def presorted_unique(cls, items) -> "Vocab":
+        """Vocab over items the CALLER guarantees unique (e.g. a
+        strictly-sorted actor table).  Skips the eager index build —
+        hashing 100k byte-string keys costs ~10ms and the bulk fold
+        paths only read ``items`` positionally; the index still builds
+        lazily on first ``intern``/lookup."""
+        v = cls.__new__(cls)
+        v.items = list(items)
+        v._index = None
+        return v
+
+    @property
+    def index(self) -> dict:
+        if self._index is None:
+            self._index = dict(zip(self.items, range(len(self.items))))
+        return self._index
+
     def intern(self, item) -> int:
-        idx = self.index.get(item)
+        index = self.index
+        idx = index.get(item)
         if idx is None:
             idx = len(self.items)
-            self.index[item] = idx
+            index[item] = idx
             self.items.append(item)
         return idx
 
@@ -218,6 +239,17 @@ def orset_fold_sparse_host(
     # orset_apply_coo would decode them against a different modulus
     clock0 = vclock_to_dense(state.clock, replicas).astype(np.int64)
     E, R = len(members), len(replicas)
+    if not state.entries and not state.deferred and E and R:
+        # the streaming shape (one combined fold into an empty state):
+        # native sort + dict assembly (statebuild.cpp) replaces the numpy
+        # lexsort and the Python writeback — measured ~5x on the config-5
+        # wall.  Falls through on any native unavailability or a shape
+        # past the packed-sort bound.
+        folded = _orset_fresh_fold_native(
+            state, kind, member, actor, counter, members, replicas, clock0
+        )
+        if folded is not None:
+            return folded
     kind = np.asarray(kind)
     member = np.asarray(member, np.int64)
     actor = np.asarray(actor, np.int64)
@@ -239,9 +271,63 @@ def orset_fold_sparse_host(
         is_last[:-1] = sk[:-1] != sk[1:]
     clock = clock0.copy()
     np.maximum.at(clock, a_ix[live], counter[live])
+    # int64 throughout: narrowing here would silently wrap a > 2^31
+    # clock (apply_coo and dense_to_vclock are dtype-agnostic)
     return orset_apply_coo(
-        state, clock.astype(np.int32), sk, sc, is_last, members, replicas
+        state, clock, sk, sc, is_last, members, replicas
     )
+
+
+def _orset_fresh_fold_native(
+    state, kind, member, actor, counter, members, replicas, clock0
+):
+    """Attempt the native fresh-state sparse fold (statebuild.cpp):
+    packed-u64 radix sort + C-API dict assembly, byte-identical to the
+    numpy/Python path below.  Returns the folded state, or None when the
+    native library is unavailable or the shape overflows the packed
+    sort (caller falls through to the Python path)."""
+    import ctypes
+
+    from .. import native
+
+    try:
+        lib = native.load_state()
+    except Exception:
+        return None
+    E, R = len(members), len(replicas)
+    kind = np.ascontiguousarray(kind, np.int8)
+    member32 = np.ascontiguousarray(member, np.int32)
+    actor32 = np.ascontiguousarray(np.minimum(actor, R), np.int32)
+    counter32 = np.ascontiguousarray(counter, np.int32)
+    if len(member32) and (
+        int(counter32.max(initial=0)) != int(np.asarray(counter).max(initial=0))
+        or int(member32.max(initial=0)) >= E
+    ):
+        return None  # int32 narrowing lost information — Python path
+    if len(clock0) and int(np.asarray(clock0).max(initial=0)) > 2 ** 31 - 1:
+        return None  # an int64 clock would wrap through the int32 gate
+    clock = np.ascontiguousarray(clock0, np.int32)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    rc = lib.orset_fresh_fold(
+        kind.ctypes.data_as(i8p),
+        member32.ctypes.data_as(i32p),
+        actor32.ctypes.data_as(i32p),
+        counter32.ctypes.data_as(i32p),
+        len(kind), E, R,
+        clock.ctypes.data_as(i32p),
+        members.items, replicas.items,
+        state.entries, state.deferred,
+    )
+    if rc == -2:
+        raise RuntimeError("native orset_fresh_fold failed")
+    if rc != 0:
+        return None
+    clock_dict = lib.dense_clock_dict(
+        clock.ctypes.data_as(i32p), R, replicas.items
+    )
+    state.clock = VClock(clock_dict)
+    return state
 
 
 def orset_apply_coo(
@@ -400,7 +486,10 @@ def counter_ops_to_columns(ops, replicas: Vocab | None = None) -> CounterColumns
 def vclock_to_dense(clock: VClock, replicas: Vocab) -> np.ndarray:
     for r in clock.counters:
         replicas.intern(r)
-    out = np.zeros(len(replicas), np.int32)
+    # int64 when any counter needs it: the sparse host path supports the
+    # full counter range (device paths bound counters to int32 upstream)
+    wide = any(c > 2 ** 31 - 1 for c in clock.counters.values())
+    out = np.zeros(len(replicas), np.int64 if wide else np.int32)
     for r, c in clock.counters.items():
         out[replicas.index[r]] = c
     return out

@@ -38,13 +38,18 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     return combine_orset_spans([part])
 
 
-def decode_orset_payload_spans(payloads, actors_sorted: list):
+def decode_orset_payload_spans(payloads, actors_sorted: list, cache=None):
     """Native two-pass decode of one payload chunk to raw span columns.
 
     ``payloads`` is a list of blob bytes, or a packed ``(buffer,
     offsets)`` pair straight from ``decrypt_blobs_packed`` — the packed
     form skips materializing and re-joining per-blob Python objects (at
     100k-tiny-file scale that overhead dwarfed the decrypt itself).
+
+    ``cache`` (optional dict the caller owns for the life of one actor
+    table, e.g. a payload stream): reuses the flattened actor table and
+    its native hash index across chunks — rebuilding both per chunk at
+    100k actors costs more than the decode.
 
     Returns ``(buf, kind, moff, mlen, actor, counter)`` — member values
     stay as (offset, length) spans into ``buf`` so chunks decoded at
@@ -77,7 +82,22 @@ def decode_orset_payload_spans(payloads, actors_sorted: list):
         np.cumsum(lens[:-1], out=bases[1:])
     buf = np.frombuffer(big, np.uint8)
     bp = buf.ctypes.data_as(native.u8p)
-    actors_flat = b"".join(actors_sorted)
+    if cache is not None and "actors" in cache:
+        actors_flat, slots = cache["actors"]
+    else:
+        actors_flat = b"".join(actors_sorted)
+        # hash index over the actor table: one probe per op instead of a
+        # 17-deep binary search at 100k actors (~2x the decode cost)
+        n_slots = 8
+        while n_slots < 2 * max(len(actors_sorted), 1):
+            n_slots *= 2
+        slots = np.empty(n_slots, np.int32)
+        lib.actor_hash_build(
+            native.in_ptr(actors_flat)[0], len(actors_sorted),
+            slots.ctypes.data_as(_i32p), n_slots,
+        )
+        if cache is not None:
+            cache["actors"] = (actors_flat, slots)
     ap, _a = native.in_ptr(actors_flat)
     basep = bases.ctypes.data_as(native.u64p)
     lenp = lens.ctypes.data_as(native.u64p)
@@ -99,8 +119,9 @@ def decode_orset_payload_spans(payloads, actors_sorted: list):
         return buf, kind, moff, mlen, actor, counter
 
     # pass 2: decode everything into consecutive row slices — one call
-    got = lib.orset_decode_batch(
+    got = lib.orset_decode_batch_h(
         bp, basep, lenp, n_payloads, ap, len(actors_sorted),
+        slots.ctypes.data_as(_i32p), len(slots),
         counts.ctypes.data_as(_i64p),
         kind.ctypes.data_as(_i8p),
         moff.ctypes.data_as(native.u64p),
