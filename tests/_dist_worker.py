@@ -1,0 +1,103 @@
+"""Worker process for tests/test_distributed_2proc.py.
+
+Runs as ``python _dist_worker.py <rank> <port>``: joins a REAL 2-process
+``jax.distributed`` cluster over a localhost coordinator (CPU backend,
+2 virtual devices per process → a (dp=2 hosts, mp=2 chips) mesh), folds
+a deterministically generated ORSet batch whose rows are split between
+the processes, and checks the sharded result against the single-device
+fold of the full batch.  Prints ``DIST_OK`` on success.
+
+This is the first real execution of the ``process_count() > 1`` branches
+of parallel/distributed.py (multihost batch assembly via
+``make_array_from_process_local_data``, ragged-row allgather) — the
+in-suite tests fake process boundaries inside one process.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PJRT_LIBRARY_PATH", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from crdt_enc_tpu import ops as K
+    from crdt_enc_tpu.parallel import distributed
+    from crdt_enc_tpu.parallel import mesh as pmesh
+
+    ok = distributed.initialize(f"localhost:{port}", 2, rank)
+    assert ok, "distributed.initialize declined an explicit configuration"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    mesh = distributed.make_multihost_mesh()
+    assert dict(mesh.shape) == {"dp": 2, "mp": 2}, mesh.shape
+
+    # deterministic global batch, identical in both processes; an odd row
+    # count split unevenly exercises the ragged-row allgather padding
+    E, R, N = 16, 8, 101
+    rng = np.random.default_rng(7)
+    kind = (rng.random(N) < 0.25).astype(np.int8)
+    member = rng.integers(0, E, N, dtype=np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = np.zeros(N, np.int32)
+    seen = np.zeros(R, np.int32)
+    for i in range(N):  # coherent per-actor dots
+        a = actor[i]
+        if kind[i] == 0:
+            seen[a] += 1
+            counter[i] = seen[a]
+        else:
+            if seen[a] == 0:
+                actor[i] = R  # padding row
+            counter[i] = seen[a]
+
+    cut = 55  # uneven halves
+    lo, hi = (0, cut) if rank == 0 else (cut, N)
+    batch = distributed.global_op_batch(
+        mesh, kind[lo:hi], member[lo:hi], actor[lo:hi], counter[lo:hi],
+        num_replicas=R,
+    )
+    n_global = batch[0].shape[0]
+    assert n_global >= N, (n_global, N)  # padded to 2x max(half)
+
+    c0 = np.zeros(R, np.int32)
+    a0 = np.zeros((E, R), np.int32)
+    r0 = np.zeros((E, R), np.int32)
+    clock0, add0, rm0 = distributed.replicate(mesh, c0, a0, r0)
+    clock, add, rm = pmesh.orset_fold_sharded(
+        mesh, clock0, add0, rm0, *batch
+    )
+
+    # reference: single-device fold of the full batch (itself pinned
+    # byte-identical to the host per-op loop by tests/test_ops_kernels.py)
+    ref = K.orset_fold(
+        c0, a0, r0, kind, member, actor, counter,
+        num_members=E, num_replicas=R,
+    )
+    for got, want, name in zip((clock, add, rm), ref, ("clock", "add", "rm")):
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=name
+        )
+
+    print(f"DIST_OK rank={rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

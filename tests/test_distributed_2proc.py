@@ -1,0 +1,63 @@
+"""Real 2-process ``jax.distributed`` run (VERDICT r3 item 5).
+
+Spawns two worker processes that rendezvous through a localhost
+coordinator on the CPU backend, build the multihost (dp=hosts, mp=chips)
+mesh, assemble a ``global_op_batch`` from disjoint per-process rows, fold
+sharded, and verify against the single-device fold.  This executes the
+``jax.process_count() > 1`` branches of parallel/distributed.py —
+DCN bootstrap, ``make_array_from_process_local_data`` assembly, the
+ragged-row allgather — with actual process boundaries, which the
+in-process tests (test_distributed.py) can only fake.
+
+Reference scale-out contract: SURVEY.md §2.3.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_dist_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_fold():
+    port = _free_port()
+    env = os.environ.copy()
+    # a wedged TPU tunnel must not hang the workers at interpreter start
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PJRT_LIBRARY_PATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=300))
+    finally:
+        for p in procs:
+            p.kill()
+    for rank, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"rank {rank} exited {p.returncode}\nstdout:\n{out}\n"
+            f"stderr:\n{err}"
+        )
+        assert f"DIST_OK rank={rank}" in out, (rank, out, err)
