@@ -65,12 +65,15 @@ def _last_good_local():
     try:
         with open(LOCAL_LOG) as f:
             lines = [ln for ln in f.read().splitlines() if ln.strip()]
-        for ln in reversed(lines):
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
             rec = json.loads(ln)
-            if rec.get("value") and rec.get("backend") == "tpu":
-                return rec
-    except (OSError, ValueError):
-        pass
+        except ValueError:
+            continue  # e.g. a truncated final append from a killed run
+        if rec.get("value") and rec.get("backend") == "tpu":
+            return rec
     return None
 
 
@@ -292,14 +295,23 @@ def main():
     interpret = jax.default_backend() != "tpu"
     if counter.max() < MAX_COUNTER and N <= MAX_ROWS:
         tile_cap = fold_cap(member, E)
-        variant_kws["pallas_bf16"] = dict(
-            _fold=lambda c, a, r, kind, member, actor, counter:
-            orset_fold_pallas(
-                c, a, r, kind, member, actor, counter,
-                num_members=E, num_replicas=R, tile_cap=tile_cap,
-                interpret=interpret,
-            ),
-        )
+
+        def pallas_variant(layout):
+            return dict(
+                _fold=lambda c, a, r, kind, member, actor, counter:
+                orset_fold_pallas(
+                    c, a, r, kind, member, actor, counter,
+                    num_members=E, num_replicas=R, tile_cap=tile_cap,
+                    interpret=interpret, layout=layout,
+                ),
+            )
+
+        # the MXU-native actor-blocked layout is the flagship; the wide
+        # round-3 layout stays as an on-hardware A/B (interpret mode is
+        # too slow to time it twice on CPU)
+        variant_kws["pallas_bf16"] = pallas_variant("ablk")
+        if not interpret:
+            variant_kws["pallas_wide"] = pallas_variant("wide")
 
     def fold_call(kw):
         """A (carry, rows...) -> carry fold closure for one variant."""
