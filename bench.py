@@ -175,6 +175,35 @@ def acquire_jax(want_tpu: bool):
     return jax, dev
 
 
+# Pinned host-baseline protocol (single source of truth — suite.py imports
+# these): the 1-core per-op loop on this box shows ±30% run-to-run spread,
+# so no speedup may rest on a single host sample.  Host baselines are the
+# MEDIAN of BENCH_HOST_RUNS (default 5) with raw samples published; device
+# times stay best-of (their marginal-chain timing is low-noise and
+# interference is one-sided), an asymmetry stated in BASELINE.md — the
+# recorded samples let anyone recompute a min-based ratio.
+HOST_RUNS = int(os.environ.get("BENCH_HOST_RUNS", 5))
+
+
+def host_median(run_once, n: int = 0):
+    """Median-of-n host baseline.  ``run_once`` returns (seconds, payload);
+    returns (median_seconds, sorted_samples, first_payload) — the payload
+    (usually the folded host state) feeds byte-equality checks."""
+    n = n or HOST_RUNS
+    runs = [run_once() for _ in range(n)]
+    times = sorted(t for t, _ in runs)
+    return times[n // 2], times, runs[0][1]
+
+
+def host_stats(times: list) -> dict:
+    """The protocol's reporting fields for a result record."""
+    med = times[len(times) // 2]
+    return dict(
+        host_samples_s=[round(t, 4) for t in times],
+        host_spread_pct=round(100.0 * (times[-1] - times[0]) / med, 1),
+    )
+
+
 # Measured spread of tunnel round-trip jitter on this host (single source of
 # truth — benchmarks/suite.py imports it): a marginal per-fold time below
 # TUNNEL_JITTER_S / chain is noise, not device time.
@@ -424,9 +453,21 @@ def main():
         full_checked = True
 
     # ---- single-core host baseline (capped subsample; O(n) per-op loop)
-    _, t_host = host_fold(kind[:N_HOST], member[:N_HOST], actor[:N_HOST], counter[:N_HOST], R)
+    # under the pinned median-of-N protocol (see host_median above)
+    def host_once():
+        state, t = host_fold(
+            kind[:N_HOST], member[:N_HOST], actor[:N_HOST], counter[:N_HOST], R
+        )
+        return t, state
+
+    t_host, host_times, _ = host_median(host_once)
     host_rate = N_HOST / t_host
-    log(f"host: {N_HOST} ops in {t_host:.3f}s → {host_rate:,.0f} ops/s")
+    stats = host_stats(host_times)
+    log(
+        f"host: {N_HOST} ops, median of {len(host_times)}: {t_host:.3f}s → "
+        f"{host_rate:,.0f} ops/s (samples {stats['host_samples_s']}, "
+        f"spread {stats['host_spread_pct']:.0f}%)"
+    )
 
     # ---- TPU fold: full batch, compile excluded.  Per-fold device time is
     # the marginal cost inside a K-chained scan (see module docstring) —
@@ -565,6 +606,7 @@ def main():
         "device_kind": dev.device_kind,
         "shape": {"N": N, "R": R, "E": E, "chain": CHAIN, "iters": ITERS},
         "host_rate": round(host_rate, 1),
+        **stats,
         "marginals_ms": {
             k: round(v * 1e3, 3) for k, v in variants.items()
         },

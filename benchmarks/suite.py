@@ -51,6 +51,12 @@ def running_count(group: np.ndarray, n_groups: int) -> np.ndarray:
     return out.astype(np.int32)
 
 
+# Pinned host-baseline protocol — the single implementation lives in
+# bench.py (median-of-BENCH_HOST_RUNS with raw samples recorded); every
+# config here measures through it so the two harnesses cannot drift.
+from bench import host_median, host_stats  # noqa: E402
+
+
 def timeit(fn, iters: int) -> float:
     import jax
 
@@ -129,11 +135,14 @@ def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     counter = running_count(actor, R)
     actors = actor_bytes_table(R)
 
-    state = GCounter()
-    t0 = time.perf_counter()
-    for a, c in zip(actor.tolist(), counter.tolist()):
-        state.apply(Dot(actors[a], c))
-    t_host = time.perf_counter() - t0
+    def host_once():
+        state = GCounter()
+        t0 = time.perf_counter()
+        for a, c in zip(actor.tolist(), counter.tolist()):
+            state.apply(Dot(actors[a], c))
+        return time.perf_counter() - t0, state
+
+    t_host, host_times, state = host_median(host_once)
 
     clock0 = np.zeros(R, np.int32)
     dev_args = [jax.device_put(x) for x in (clock0, actor, counter)]
@@ -161,7 +170,7 @@ def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     return dict(
         config="gcounter_4x1k", metric="ops_folded_per_sec", N=N, R=R,
         host_rate=N / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
-        timing=timing, bytes_model=8 * N + 2 * 4 * R,
+        timing=timing, bytes_model=8 * N + 2 * 4 * R, **host_stats(host_times),
     )
 
 
@@ -182,13 +191,18 @@ def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     actors = actor_bytes_table(R)
 
     n_host = min(N, 200_000)
-    state = PNCounter()
-    t0 = time.perf_counter()
-    for a, s, c in zip(
-        actor[:n_host].tolist(), sign[:n_host].tolist(), counter[:n_host].tolist()
-    ):
-        state.apply((int(s), Dot(actors[a], c)))
-    t_host = time.perf_counter() - t0
+
+    def host_once():
+        state = PNCounter()
+        t0 = time.perf_counter()
+        for a, s, c in zip(
+            actor[:n_host].tolist(), sign[:n_host].tolist(),
+            counter[:n_host].tolist(),
+        ):
+            state.apply((int(s), Dot(actors[a], c)))
+        return time.perf_counter() - t0, state
+
+    t_host, host_times, state = host_median(host_once)
 
     p0 = np.zeros(R, np.int32)
     n0 = np.zeros(R, np.int32)
@@ -224,6 +238,7 @@ def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
         config="pncounter_1kx100k", metric="ops_folded_per_sec", N=N, R=R,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
         timing=timing, bytes_model=9 * N + 4 * 4 * R,
+        **host_stats(host_times),
     )
 
 
@@ -284,9 +299,13 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
     )
     equal = codec.pack(t_state.to_obj()) == codec.pack(h_state.to_obj())
 
-    _, t_host = north.host_fold(
-        kind[:n_host], member[:n_host], actor[:n_host], counter[:n_host], R
-    )
+    def host_once():
+        state, t = north.host_fold(
+            kind[:n_host], member[:n_host], actor[:n_host], counter[:n_host], R
+        )
+        return t, state
+
+    t_host, host_times, _ = host_median(host_once)
     args = [jax.device_put(x) for x in (c0, a0, r0, kind, member, actor, counter)]
 
     def make_chained(n):
@@ -315,6 +334,7 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
         config="orset_10kx1M", metric="ops_folded_per_sec", N=N, R=R, E=E,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
         timing=timing, bytes_model=_orset_bytes_model(N, E, R),
+        **host_stats(host_times),
     )
 
 
@@ -340,14 +360,17 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
     hi, lo = ts_split(ts)
     actors = actor_bytes_table(R)
 
-    state = LWWMap()
-    t0 = time.perf_counter()
-    for k, t, a, v in zip(
-        key[:n_host].tolist(), ts[:n_host].tolist(),
-        actor[:n_host].tolist(), value[:n_host].tolist(),
-    ):
-        state.apply(LWWOp(k, t, actors[a], v))
-    t_host = time.perf_counter() - t0
+    def host_once():
+        state = LWWMap()
+        t0 = time.perf_counter()
+        for k, t, a, v in zip(
+            key[:n_host].tolist(), ts[:n_host].tolist(),
+            actor[:n_host].tolist(), value[:n_host].tolist(),
+        ):
+            state.apply(LWWOp(k, t, actors[a], v))
+        return time.perf_counter() - t0, state
+
+    t_host, host_times, state = host_median(host_once)
 
     args = [jax.device_put(x) for x in (key, hi, lo, actor, value)]
     # value domain is 0..99 rank-interned, so the (actor, value) cascades
@@ -428,6 +451,7 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
         K=K_keys, R=R,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
         timing=timing, bytes_model=20 * N + 2 * 20 * K_keys,
+        **host_stats(host_times),
     )
 
 
@@ -498,10 +522,9 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
     log(f"  streaming: {n_files} files, {len(headers)} headers")
 
     # ---- single-core host baseline: sequential decrypt → decode → apply,
-    # best of `iters` passes (single-pass timing showed 3x run-to-run
-    # variance from machine load; every other config is best-of too)
-    t_host = float("inf")
-    for _ in range(max(iters, 2)):
+    # median-of-HOST_RUNS passes with raw samples recorded (the pinned
+    # protocol — single-pass timing showed 3x run-to-run variance)
+    def host_once():
         state = ORSet()
         t0 = time.perf_counter()
         for blob in payloads[:n_host_files]:
@@ -513,7 +536,9 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
                     state.apply(RmOp(o[1], VClock.from_obj(o[2])))
         for h in headers:
             MVReg.from_obj(codec.unpack(decrypt_blob(key, h)))
-        t_host = min(t_host, time.perf_counter() - t0)
+        return time.perf_counter() - t0, state
+
+    t_host, host_times, state = host_median(host_once)
     host_rate = n_ops / t_host
 
     # ---- streaming pipeline: chunked threaded batch decrypt overlapping
@@ -559,6 +584,7 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
         config="mixed_streaming_100k", metric="ops_streamed_per_sec",
         N=total_ops, R=R, E=E, files=n_files,
         host_rate=host_rate, device_rate=dev_rate, byte_equal=bool(equal),
+        **host_stats(host_times),
         # end-to-end host pipeline (AEAD + decode dominate): the HBM
         # roofline is not the binding resource, so no pct is reported
         timing="end_to_end", bytes_model=None,

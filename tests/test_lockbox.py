@@ -44,15 +44,21 @@ def test_rejects_coroutine_function():
 
 def test_rejects_returned_awaitable():
     lb = LockBox(Box())
+    made = []
 
     def sneaky(b):
         async def inner():
             return b.n
 
-        return inner()
+        coro = inner()
+        made.append(coro)
+        return coro
 
     with pytest.raises(TypeError, match="suspendable"):
         lb.with_(sneaky)
+    # the rejected coroutine was never awaited by design — close it so
+    # the interpreter doesn't warn at GC time
+    made[0].close()
 
 
 def test_rejects_returned_generator():
