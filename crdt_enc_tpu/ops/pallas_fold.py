@@ -411,6 +411,9 @@ def _fold_ablk(
     sentinel = n_segs * SEG
     key = jnp.where(is_add | is_rm, seg_id * SEG + within, sentinel)
     gval = jnp.where(is_add | is_rm, counter, 0)
+    # (a single-operand key·2^14+counter packed sort would halve the
+    # comparator's operand traffic, but int64 is unavailable under the
+    # default x64-disabled config and the key space overflows int32)
     skey, sval = jax.lax.sort((key, gval), num_keys=2)
     nxt = jnp.concatenate([skey[1:], jnp.full((1,), -1, skey.dtype)])
     sval = jnp.where((skey != nxt) & (skey < sentinel), sval, 0)

@@ -45,6 +45,24 @@ def _fold_donated(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("num_members", "num_replicas", "tile_cap", "interpret"),
+    donate_argnums=(0, 1, 2),
+)
+def _fold_donated_pallas(
+    clock, add, rm, kind, member, actor, counter,
+    *, num_members, num_replicas, tile_cap, interpret,
+):
+    from .pallas_fold import orset_fold_pallas
+
+    return orset_fold_pallas(
+        clock, add, rm, kind, member, actor, counter,
+        num_members=num_members, num_replicas=num_replicas,
+        tile_cap=tile_cap, interpret=interpret,
+    )
+
+
 def iter_orset_chunks(kind, member, actor, counter, chunk_rows: int, num_replicas: int):
     """Slice flat op columns into fixed-shape chunks (the tail is padded
     with ``actor == num_replicas`` sentinel rows, which every kernel
@@ -75,6 +93,7 @@ def orset_fold_stream(
     num_replicas: int,
     impl: str = "fused",
     small_counters: bool = False,
+    tile_cap: int | None = None,
 ):
     """Fold an iterable of fixed-shape op chunks into the state planes.
 
@@ -82,10 +101,27 @@ def orset_fold_stream(
     common row count (see :func:`iter_orset_chunks`).  Returns the folded
     ``(clock, add, rm)`` device arrays.  The planes are donated between
     chunks — do not reuse the input arrays after calling.
+
+    ``impl="pallas"`` runs each chunk through the MXU fold
+    (ops/pallas_fold.py); pass ``tile_cap`` computed over the WHOLE
+    member column (``fold_cap``) so every chunk compiles once — a
+    per-chunk cap is bounded by the global one.
     """
     clock = jax.device_put(np.asarray(clock0, np.int32))
     add = jax.device_put(np.asarray(add0, np.int32))
     rm = jax.device_put(np.asarray(rm0, np.int32))
+    if impl == "pallas":
+        from .pallas_fold import fold_cap
+
+        interpret = jax.default_backend() != "tpu"
+        for kind, member, actor, counter in chunks:
+            cap = tile_cap or fold_cap(member, num_members)
+            clock, add, rm = _fold_donated_pallas(
+                clock, add, rm, kind, member, actor, counter,
+                num_members=num_members, num_replicas=num_replicas,
+                tile_cap=cap, interpret=interpret,
+            )
+        return clock, add, rm
     for kind, member, actor, counter in chunks:
         clock, add, rm = _fold_donated(
             clock, add, rm, kind, member, actor, counter,

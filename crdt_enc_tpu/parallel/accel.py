@@ -152,14 +152,29 @@ class TpuAccelerator(HostAccelerator):
         with trace.span("fold.device"):
             if n_rows > self.STREAM_CHUNK_ROWS:
                 # blockwise fold with donated plane buffers: bounded device
-                # memory for arbitrarily large ingests (ops/stream.py)
+                # memory for arbitrarily large ingests (ops/stream.py).
+                # Chunks route through the Pallas MXU fold when eligible —
+                # the streaming path must run the same flagship kernel the
+                # dense path does.
+                import jax
+
+                from ..ops import pallas_fold as PF
+
+                stream_kw = {}
+                if (
+                    jax.default_backend() == "tpu"
+                    and int(np.max(counter, initial=0)) < PF.MAX_COUNTER
+                ):
+                    stream_kw = dict(
+                        impl="pallas", tile_cap=PF.fold_cap(member, E)
+                    )
                 clock, add, rm = K.orset_fold_stream(
                     clock0, add0, rm0,
                     K.iter_orset_chunks(
                         kind, member, actor, counter,
                         self.STREAM_CHUNK_ROWS, R,
                     ),
-                    num_members=E, num_replicas=R,
+                    num_members=E, num_replicas=R, **stream_kw,
                 )
             else:
                 cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)

@@ -450,3 +450,17 @@ def test_orset_fold_stream_matches_whole_batch():
     )
     assert canonical_bytes(streamed) == canonical_bytes(whole)
     assert canonical_bytes(streamed) == canonical_bytes(host)
+
+    # the Pallas chunk route (interpret mode here; real MXU on TPU) must
+    # produce the same planes
+    clock, add, rm = K.orset_fold_stream(
+        np.zeros(R, np.int32), np.zeros((E, R), np.int32),
+        np.zeros((E, R), np.int32),
+        K.iter_orset_chunks(cols.kind, cols.member, cols.actor, cols.counter,
+                            chunk_rows=16, num_replicas=R),
+        num_members=E, num_replicas=R, impl="pallas",
+    )
+    streamed_p = K.orset_planes_to_state(
+        np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
+    )
+    assert canonical_bytes(streamed_p) == canonical_bytes(host)
