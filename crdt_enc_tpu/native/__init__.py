@@ -114,6 +114,8 @@ def _bind_state(lib) -> None:
     lib.orset_fresh_fold.restype = ctypes.c_int
     lib.dense_clock_dict.argtypes = [i32p, ctypes.c_int64, ctypes.py_object]
     lib.dense_clock_dict.restype = ctypes.py_object
+    lib.canon_pack.argtypes = [ctypes.py_object]
+    lib.canon_pack.restype = ctypes.py_object
 
 
 def _bind(lib) -> None:
@@ -174,28 +176,27 @@ def _bind(lib) -> None:
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, u8p
     ]
     lib.read_op_files.restype = ctypes.c_int64
-    lib.orset_count_rows_batch.argtypes = [
-        u8p, u64p, u64p, ctypes.c_uint64, i64p
-    ]
-    lib.orset_count_rows_batch.restype = ctypes.c_int64
-    lib.orset_decode_batch.argtypes = [
-        u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64, i64p,
-        ctypes.POINTER(ctypes.c_int8), u64p, u64p,
-        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-    ]
-    lib.orset_decode_batch.restype = ctypes.c_int64
+    # (the two-pass count+decode batch protocol still exists in C —
+    # orset_count_rows_batch / orset_decode_batch[_h] — but the Python
+    # span decoder moved to the single-pass grow/take protocol below, so
+    # only the live entry points are bound)
     lib.actor_hash_build.argtypes = [
         u8p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
         ctypes.c_uint64,
     ]
     lib.actor_hash_build.restype = None
-    lib.orset_decode_batch_h.argtypes = [
+    lib.orset_decode_batch_grow.argtypes = [
         u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64, i64p,
-        ctypes.POINTER(ctypes.c_int8), u64p, u64p,
+    ]
+    lib.orset_decode_batch_grow.restype = ctypes.c_void_p
+    lib.orset_decode_take.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int8), u64p, u64p,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
     ]
-    lib.orset_decode_batch_h.restype = ctypes.c_int64
+    lib.orset_decode_take.restype = None
+    lib.orset_decode_drop.argtypes = [ctypes.c_void_p]
+    lib.orset_decode_drop.restype = None
     lib.counter_decode_batch.argtypes = [
         u8p, u64p, u64p, ctypes.c_uint64, u8p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_int8),

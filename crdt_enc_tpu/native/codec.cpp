@@ -21,6 +21,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -172,6 +173,84 @@ inline int actor_lookup(const ActorLookup& t, const uint8_t* a) {
   }
 }
 
+template <typename Sink>
+int64_t orset_decode_sink(const uint8_t* buf, uint64_t len,
+                          const ActorLookup& look, Sink& sink) {
+  Reader r{buf, buf + len};
+  uint64_t n_ops;
+  if (!r.arr(&n_ops)) return -1;
+  int64_t row = 0;
+  for (uint64_t i = 0; i < n_ops; i++) {
+    uint64_t three, kind;
+    if (!r.arr(&three) || three != 3 || !r.uint(&kind)) return -1;
+    const uint8_t* mspan;
+    uint64_t mlen;
+    if (!r.span(&mspan, &mlen)) return -1;
+    uint64_t moff = (uint64_t)(mspan - buf);
+    if (kind == 0) {
+      uint64_t two;
+      const uint8_t* a;
+      uint64_t alen, counter;
+      if (!r.arr(&two) || two != 2 || !r.bin(&a, &alen) || alen != 16 ||
+          !r.uint(&counter))
+        return -1;
+      int ai = actor_lookup(look, a);
+      if (ai < 0) return -1;
+      sink.emit(0, moff, mlen, ai, (int32_t)counter);
+      row++;
+    } else if (kind == 1) {
+      uint64_t m;
+      if (!r.map(&m)) return -1;
+      for (uint64_t j = 0; j < m; j++) {
+        const uint8_t* a;
+        uint64_t alen, counter;
+        if (!r.bin(&a, &alen) || alen != 16 || !r.uint(&counter)) return -1;
+        int ai = actor_lookup(look, a);
+        if (ai < 0) return -1;
+        sink.emit(1, moff, mlen, ai, (int32_t)counter);
+        row++;
+      }
+    } else {
+      return -1;
+    }
+  }
+  return row;
+}
+
+// Fixed-array sink: caller pre-sized the outputs (orset_count_rows).
+struct ArraySink {
+  int8_t* kind;
+  uint64_t* moff;
+  uint64_t* mlen;
+  int32_t* actor;
+  int32_t* counter;
+  int64_t row = 0;
+  inline void emit(int8_t k, uint64_t mo, uint64_t ml, int32_t a,
+                   int32_t c) {
+    kind[row] = k;
+    moff[row] = mo;
+    mlen[row] = ml;
+    actor[row] = a;
+    counter[row] = c;
+    row++;
+  }
+};
+
+// Growable sink: single-pass decode with no pre-counting walk.
+struct GrowSink {
+  std::vector<int8_t> kind;
+  std::vector<uint64_t> moff, mlen;
+  std::vector<int32_t> actor, counter;
+  inline void emit(int8_t k, uint64_t mo, uint64_t ml, int32_t a,
+                   int32_t c) {
+    kind.push_back(k);
+    moff.push_back(mo);
+    mlen.push_back(ml);
+    actor.push_back(a);
+    counter.push_back(c);
+  }
+};
+
 }  // namespace
 
 extern "C" {
@@ -214,53 +293,9 @@ int64_t orset_decode_look(const uint8_t* buf, uint64_t len,
                           const ActorLookup& look, int8_t* kind_out,
                           uint64_t* member_off_out, uint64_t* member_len_out,
                           int32_t* actor_out, int32_t* counter_out) {
-  Reader r{buf, buf + len};
-  uint64_t n_ops;
-  if (!r.arr(&n_ops)) return -1;
-  int64_t row = 0;
-  for (uint64_t i = 0; i < n_ops; i++) {
-    uint64_t three, kind;
-    if (!r.arr(&three) || three != 3 || !r.uint(&kind)) return -1;
-    const uint8_t* mspan;
-    uint64_t mlen;
-    if (!r.span(&mspan, &mlen)) return -1;
-    uint64_t moff = (uint64_t)(mspan - buf);
-    if (kind == 0) {
-      uint64_t two;
-      const uint8_t* a;
-      uint64_t alen, counter;
-      if (!r.arr(&two) || two != 2 || !r.bin(&a, &alen) || alen != 16 ||
-          !r.uint(&counter))
-        return -1;
-      int ai = actor_lookup(look, a);
-      if (ai < 0) return -1;
-      kind_out[row] = 0;
-      member_off_out[row] = moff;
-      member_len_out[row] = mlen;
-      actor_out[row] = ai;
-      counter_out[row] = (int32_t)counter;
-      row++;
-    } else if (kind == 1) {
-      uint64_t m;
-      if (!r.map(&m)) return -1;
-      for (uint64_t j = 0; j < m; j++) {
-        const uint8_t* a;
-        uint64_t alen, counter;
-        if (!r.bin(&a, &alen) || alen != 16 || !r.uint(&counter)) return -1;
-        int ai = actor_lookup(look, a);
-        if (ai < 0) return -1;
-        kind_out[row] = 1;
-        member_off_out[row] = moff;
-        member_len_out[row] = mlen;
-        actor_out[row] = ai;
-        counter_out[row] = (int32_t)counter;
-        row++;
-      }
-    } else {
-      return -1;
-    }
-  }
-  return row;
+  ArraySink sink{kind_out, member_off_out, member_len_out, actor_out,
+                 counter_out};
+  return orset_decode_sink(buf, len, look, sink);
 }
 
 // Sorted-table entry point (legacy signature): binary-search lookup.
@@ -343,6 +378,58 @@ int64_t orset_decode_batch(const uint8_t* buf, const uint64_t* bases,
                               nullptr, 0, counts, kind_out, member_off_out,
                               member_len_out, actor_out, counter_out);
 }
+
+// Single-pass growable batch decode: no pre-counting walk (the count
+// pass re-parses every payload — ~half the decode cost at the config-5
+// shape).  Returns an opaque handle + row count via n_rows_out, or
+// nullptr on malformed input / unknown actor.  The caller copies the
+// columns out with orset_decode_take (which frees the handle).
+void* orset_decode_batch_grow(const uint8_t* buf, const uint64_t* bases,
+                              const uint64_t* lens, uint64_t n_payloads,
+                              const uint8_t* actors, uint64_t n_actors,
+                              const int32_t* slots, uint64_t n_slots,
+                              int64_t* n_rows_out) {
+  ActorLookup look{actors, n_actors, slots, n_slots ? n_slots - 1 : 0};
+  GrowSink* sink = nullptr;
+  // bad_alloc from vector growth must not unwind through the extern "C"
+  // boundary into ctypes (std::terminate); nullptr = caller falls back
+  try {
+    sink = new GrowSink();
+    sink->kind.reserve(4 * n_payloads);
+    for (uint64_t i = 0; i < n_payloads; i++) {
+      const size_t before = sink->kind.size();
+      int64_t got = orset_decode_sink(buf + bases[i], lens[i], look, *sink);
+      if (got < 0) {
+        delete sink;
+        return nullptr;
+      }
+      for (size_t j = before; j < sink->kind.size(); j++)
+        sink->moff[j] += bases[i];
+    }
+  } catch (const std::bad_alloc&) {
+    delete sink;
+    return nullptr;
+  }
+  *n_rows_out = (int64_t)sink->kind.size();
+  return sink;
+}
+
+void orset_decode_take(void* h, int8_t* kind_out, uint64_t* member_off_out,
+                       uint64_t* member_len_out, int32_t* actor_out,
+                       int32_t* counter_out) {
+  GrowSink* sink = (GrowSink*)h;
+  const size_t n = sink->kind.size();
+  if (n) {
+    memcpy(kind_out, sink->kind.data(), n * sizeof(int8_t));
+    memcpy(member_off_out, sink->moff.data(), n * sizeof(uint64_t));
+    memcpy(member_len_out, sink->mlen.data(), n * sizeof(uint64_t));
+    memcpy(actor_out, sink->actor.data(), n * sizeof(int32_t));
+    memcpy(counter_out, sink->counter.data(), n * sizeof(int32_t));
+  }
+  delete sink;
+}
+
+void orset_decode_drop(void* h) { delete (GrowSink*)h; }
 
 // Decode a counter op-file payload: array of [dir, [actor16, counter]]
 // (PN-Counter) or [actor16, counter] (G-Counter).  Returns rows or -1.

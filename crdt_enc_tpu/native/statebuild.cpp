@@ -28,6 +28,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -145,11 +146,11 @@ extern "C" {
 //
 // Returns 0 on success, -1 if the shape overflows the packed-key sort
 // (caller must use the Python path), -2 on a Python error.
-int orset_fresh_fold(const int8_t* kind, const int32_t* member,
-                     const int32_t* actor, const int32_t* counter, int64_t n,
-                     int64_t E, int64_t R, int32_t* clock,
-                     PyObject* member_objs, PyObject* actor_objs,
-                     PyObject* entries, PyObject* deferred) {
+int orset_fresh_fold_impl(const int8_t* kind, const int32_t* member,
+                          const int32_t* actor, const int32_t* counter,
+                          int64_t n, int64_t E, int64_t R, int32_t* clock,
+                          PyObject* member_objs, PyObject* actor_objs,
+                          PyObject* entries, PyObject* deferred) {
     // pass 0: max counter over participating rows (packing modulus)
     int64_t maxc = 0;
     for (int64_t i = 0; i < n; ++i) {
@@ -231,6 +232,201 @@ int orset_fresh_fold(const int8_t* kind, const int32_t* member,
         return -2;
     return 0;
 }
+
+int orset_fresh_fold(const int8_t* kind, const int32_t* member,
+                     const int32_t* actor, const int32_t* counter, int64_t n,
+                     int64_t E, int64_t R, int32_t* clock,
+                     PyObject* member_objs, PyObject* actor_objs,
+                     PyObject* entries, PyObject* deferred) {
+    // a bad_alloc must not unwind into ctypes; -1 = Python-path fallback.
+    // Safe to retry in Python: vector allocation happens strictly before
+    // any dict mutation (emit_groups allocates through the C-API, whose
+    // failures surface as rc=-2 Python errors, not C++ exceptions), and
+    // the caller's clock array is a scratch copy it discards on fallback.
+    try {
+        return orset_fresh_fold_impl(kind, member, actor, counter, n, E, R,
+                                     clock, member_objs, actor_objs, entries,
+                                     deferred);
+    } catch (const std::bad_alloc&) {
+        return -1;
+    }
+}
+
+// ---------------------------------------------------------------------
+// Canonical msgpack packer — the native twin of utils/codec.py pack():
+// smallest-encoding msgpack with use_bin_type=True semantics and every
+// map emitted with keys sorted by their packed bytes.  Sealing a
+// compacted state at the 100k-replica scale spent ~400ms in the Python
+// _canon + packb walk; this emits the identical bytes in one C pass.
+// Unsupported types return 0 and the Python caller falls back.
+// ---------------------------------------------------------------------
+
+namespace {
+
+struct Out {
+  std::vector<uint8_t> b;
+  void u8(uint8_t v) { b.push_back(v); }
+  void be16(uint16_t v) { u8(v >> 8); u8(v & 0xff); }
+  void be32(uint32_t v) { be16(v >> 16); be16(v & 0xffff); }
+  void be64(uint64_t v) { be32(v >> 32); be32(v & 0xffffffffull); }
+  void raw(const void* p, size_t n) {
+    const uint8_t* c = (const uint8_t*)p;
+    b.insert(b.end(), c, c + n);
+  }
+};
+
+// returns 1 ok, 0 unsupported (no exception), -1 python error (exc set)
+int canon_emit(PyObject* obj, Out& out, int depth) {
+  if (depth > 200) return 0;
+  if (obj == Py_None) { out.u8(0xc0); return 1; }
+  if (obj == Py_True) { out.u8(0xc3); return 1; }
+  if (obj == Py_False) { out.u8(0xc2); return 1; }
+  if (PyLong_CheckExact(obj)) {
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(obj, &overflow);
+    if (overflow > 0) {
+      unsigned long long u = PyLong_AsUnsignedLongLong(obj);
+      if (u == (unsigned long long)-1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        return 0;  // > 2^64-1: let the Python packer raise its error
+      }
+      out.u8(0xcf);
+      out.be64(u);
+      return 1;
+    }
+    if (overflow < 0) return 0;  // < -2^63
+    if (v == -1 && PyErr_Occurred()) return -1;
+    if (v >= 0) {
+      unsigned long long u = (unsigned long long)v;
+      if (u < 0x80) out.u8((uint8_t)u);
+      else if (u <= 0xff) { out.u8(0xcc); out.u8((uint8_t)u); }
+      else if (u <= 0xffff) { out.u8(0xcd); out.be16((uint16_t)u); }
+      else if (u <= 0xffffffffull) { out.u8(0xce); out.be32((uint32_t)u); }
+      else { out.u8(0xcf); out.be64(u); }
+    } else {
+      if (v >= -32) out.u8((uint8_t)(int8_t)v);
+      else if (v >= -128) { out.u8(0xd0); out.u8((uint8_t)(int8_t)v); }
+      else if (v >= -32768) { out.u8(0xd1); out.be16((uint16_t)(int16_t)v); }
+      else if (v >= -2147483648ll) {
+        out.u8(0xd2);
+        out.be32((uint32_t)(int32_t)v);
+      } else {
+        out.u8(0xd3);
+        out.be64((uint64_t)v);
+      }
+    }
+    return 1;
+  }
+  if (PyBytes_CheckExact(obj)) {
+    const size_t n = (size_t)PyBytes_GET_SIZE(obj);
+    if (n <= 0xff) { out.u8(0xc4); out.u8((uint8_t)n); }
+    else if (n <= 0xffff) { out.u8(0xc5); out.be16((uint16_t)n); }
+    else if (n <= 0xffffffffull) { out.u8(0xc6); out.be32((uint32_t)n); }
+    else return 0;
+    out.raw(PyBytes_AS_STRING(obj), n);
+    return 1;
+  }
+  if (PyUnicode_CheckExact(obj)) {
+    Py_ssize_t n;
+    const char* s = PyUnicode_AsUTF8AndSize(obj, &n);
+    if (s == nullptr) return -1;
+    if (n < 32) out.u8(0xa0 | (uint8_t)n);
+    else if (n <= 0xff) { out.u8(0xd9); out.u8((uint8_t)n); }
+    else if (n <= 0xffff) { out.u8(0xda); out.be16((uint16_t)n); }
+    else if ((unsigned long long)n <= 0xffffffffull) {
+      out.u8(0xdb);
+      out.be32((uint32_t)n);
+    } else return 0;
+    out.raw(s, (size_t)n);
+    return 1;
+  }
+  if (PyFloat_CheckExact(obj)) {
+    double d = PyFloat_AS_DOUBLE(obj);
+    uint64_t bits;
+    memcpy(&bits, &d, 8);
+    out.u8(0xcb);
+    out.be64(bits);
+    return 1;
+  }
+  if (PyList_CheckExact(obj) || PyTuple_CheckExact(obj)) {
+    const int is_list = PyList_CheckExact(obj);
+    const Py_ssize_t n =
+        is_list ? PyList_GET_SIZE(obj) : PyTuple_GET_SIZE(obj);
+    if (n < 16) out.u8(0x90 | (uint8_t)n);
+    else if (n <= 0xffff) { out.u8(0xdc); out.be16((uint16_t)n); }
+    else if ((unsigned long long)n <= 0xffffffffull) {
+      out.u8(0xdd);
+      out.be32((uint32_t)n);
+    } else return 0;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* it =
+          is_list ? PyList_GET_ITEM(obj, i) : PyTuple_GET_ITEM(obj, i);
+      int rc = canon_emit(it, out, depth + 1);
+      if (rc != 1) return rc;
+    }
+    return 1;
+  }
+  if (PyDict_CheckExact(obj)) {
+    const Py_ssize_t n = PyDict_GET_SIZE(obj);
+    if (n < 16) out.u8(0x80 | (uint8_t)n);
+    else if (n <= 0xffff) { out.u8(0xde); out.be16((uint16_t)n); }
+    else if ((unsigned long long)n <= 0xffffffffull) {
+      out.u8(0xdf);
+      out.be32((uint32_t)n);
+    } else return 0;
+    // pack (key bytes, value bytes) pairs, sort by key bytes — the
+    // canonical-map ordering codec.pack defines
+    struct Pair {
+      std::vector<uint8_t> k, v;
+    };
+    std::vector<Pair> pairs;
+    pairs.reserve((size_t)n);
+    Py_ssize_t pos = 0;
+    PyObject *key, *val;
+    while (PyDict_Next(obj, &pos, &key, &val)) {
+      Out ko, vo;
+      int rc = canon_emit(key, ko, depth + 1);
+      if (rc != 1) return rc;
+      rc = canon_emit(val, vo, depth + 1);
+      if (rc != 1) return rc;
+      pairs.push_back(Pair{std::move(ko.b), std::move(vo.b)});
+    }
+    std::sort(pairs.begin(), pairs.end(),
+              [](const Pair& a, const Pair& b) { return a.k < b.k; });
+    for (const Pair& p : pairs) {
+      out.raw(p.k.data(), p.k.size());
+      out.raw(p.v.data(), p.v.size());
+    }
+    return 1;
+  }
+  return 0;  // sets, numpy scalars, custom types → Python fallback
+}
+
+}  // namespace
+
+extern "C" {
+
+// Canonical-pack ``obj``; returns a bytes object, Py_None when the
+// object graph contains a type this packer does not handle (caller
+// falls back to the Python path), or NULL on a Python error.
+PyObject* canon_pack(PyObject* obj) {
+  // bad_alloc from buffer growth must not unwind into ctypes — surface
+  // it as a Python MemoryError instead (same convention as the fold and
+  // decode entry points)
+  try {
+    Out out;
+    out.b.reserve(256);
+    int rc = canon_emit(obj, out, 0);
+    if (rc < 0) return nullptr;
+    if (rc == 0) Py_RETURN_NONE;
+    return PyBytes_FromStringAndSize((const char*)out.b.data(),
+                                     (Py_ssize_t)out.b.size());
+  } catch (const std::bad_alloc&) {
+    return PyErr_NoMemory();
+  }
+}
+
+}  // extern "C" (canon_pack; the outer linkage block continues below)
 
 // Build {actor_obj: counter} for the nonzero entries of a dense clock —
 // the native twin of ops/columnar.py dense_to_vclock's dict body.

@@ -102,35 +102,37 @@ def decode_orset_payload_spans(payloads, actors_sorted: list, cache=None):
     basep = bases.ctypes.data_as(native.u64p)
     lenp = lens.ctypes.data_as(native.u64p)
 
-    # pass 1: row counts (also validates framing) — one native call
-    counts = np.zeros(n_payloads, np.int64)
-    total = lib.orset_count_rows_batch(
-        bp, basep, lenp, n_payloads, counts.ctypes.data_as(_i64p)
-    )
-    if total < 0:
-        return None
-
-    kind = np.zeros(total, np.int8)
-    moff = np.zeros(total, np.uint64)
-    mlen = np.zeros(total, np.uint64)
-    actor = np.zeros(total, np.int32)
-    counter = np.zeros(total, np.int32)
-    if total == 0:
-        return buf, kind, moff, mlen, actor, counter
-
-    # pass 2: decode everything into consecutive row slices — one call
-    got = lib.orset_decode_batch_h(
+    # single-pass growable decode: validates framing and emits rows in
+    # one msgpack walk (the old count+decode protocol parsed everything
+    # twice — ~half the decode cost at 100k-file scale)
+    n_rows = np.zeros(1, np.int64)
+    handle = lib.orset_decode_batch_grow(
         bp, basep, lenp, n_payloads, ap, len(actors_sorted),
         slots.ctypes.data_as(_i32p), len(slots),
-        counts.ctypes.data_as(_i64p),
-        kind.ctypes.data_as(_i8p),
-        moff.ctypes.data_as(native.u64p),
-        mlen.ctypes.data_as(native.u64p),
-        actor.ctypes.data_as(_i32p),
-        counter.ctypes.data_as(_i32p),
+        n_rows.ctypes.data_as(_i64p),
     )
-    if got != total:
+    if not handle:
         return None
+    taken = False
+    try:
+        total = int(n_rows[0])
+        kind = np.zeros(total, np.int8)
+        moff = np.zeros(total, np.uint64)
+        mlen = np.zeros(total, np.uint64)
+        actor = np.zeros(total, np.int32)
+        counter = np.zeros(total, np.int32)
+        taken = True  # take() frees the handle even if a copy would fail
+        lib.orset_decode_take(
+            handle,
+            kind.ctypes.data_as(_i8p),
+            moff.ctypes.data_as(native.u64p),
+            mlen.ctypes.data_as(native.u64p),
+            actor.ctypes.data_as(_i32p),
+            counter.ctypes.data_as(_i32p),
+        )
+    finally:
+        if not taken:  # e.g. MemoryError sizing the output arrays
+            lib.orset_decode_drop(handle)
     return buf, kind, moff, mlen, actor, counter
 
 

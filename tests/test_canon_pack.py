@@ -1,0 +1,103 @@
+"""The native canonical msgpack packer (statebuild.cpp ``canon_pack``)
+must emit byte-identical output to the Python canonical path
+(``msgpack.packb(_canon(obj))``) on everything it accepts, and decline
+(return None) anything it cannot — ``codec.pack`` falls back silently,
+so a silent divergence here would corrupt every persisted state.
+"""
+
+from __future__ import annotations
+
+import msgpack
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from crdt_enc_tpu.utils import codec
+
+
+def _native():
+    from crdt_enc_tpu import native
+
+    try:
+        return native.load_state()
+    except Exception:
+        pytest.skip("native state library unavailable")
+
+
+def _python_pack(obj) -> bytes:
+    return msgpack.packb(codec._canon(obj), use_bin_type=True)
+
+
+EDGES = [
+    None, True, False,
+    0, 1, 127, 128, 255, 256, 65535, 65536, 2 ** 32 - 1, 2 ** 32,
+    2 ** 63 - 1, 2 ** 63, 2 ** 64 - 1,
+    -1, -31, -32, -33, -128, -129, -32768, -32769, -2 ** 31, -2 ** 31 - 1,
+    -2 ** 63,
+    1.5, -0.0,
+    b"", b"x" * 255, b"y" * 256, b"z" * 70000,
+    "", "a" * 31, "b" * 32, "c" * 255, "d" * 256, "é" * 100,
+    [], [1, 2, 3], tuple(range(20)),
+    {}, {b"b": 1, b"a": 2}, {1: "x", "1": "y", b"1": b"z"},
+    {b"c": {b"k": [1, b"v", None]}, b"e": {5: {b"a": 2 ** 40}}, b"d": {}},
+    [{"k": (1, 2)}, {2: [3, {4: 5}]}],
+    list(range(70000)),           # array32 header
+    {i: i * 2 for i in range(70000)},  # map32 header + big sort
+]
+
+
+def test_edge_cases_byte_identical():
+    lib = _native()
+    for case in EDGES:
+        assert lib.canon_pack(case) == _python_pack(case), repr(case)[:80]
+
+
+def test_unsupported_types_decline():
+    import numpy as np
+
+    lib = _native()
+    for case in ({1, 2}, object(), np.int32(5), 2 ** 64, -2 ** 63 - 1):
+        assert lib.canon_pack(case) is None
+    # the fallback still packs what msgpack can take
+    assert codec.pack(5) == _python_pack(5)
+    # ...and raises identically on what it can't (set → the Python
+    # packer's TypeError, not silence)
+    with pytest.raises(TypeError):
+        codec.pack({1, 2})
+
+
+_scalar = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2 ** 63), max_value=2 ** 64 - 1),
+    st.binary(max_size=40),
+    st.text(max_size=20),
+    st.floats(allow_nan=False),
+)
+_key = st.one_of(
+    st.integers(min_value=0, max_value=2 ** 20),
+    st.binary(min_size=1, max_size=16),
+    st.text(min_size=1, max_size=8),
+)
+_value = st.recursive(
+    _scalar,
+    lambda children: st.one_of(
+        st.lists(children, max_size=5),
+        st.dictionaries(_key, children, max_size=5),
+    ),
+    max_leaves=30,
+)
+
+
+@settings(max_examples=150, deadline=None)
+@given(obj=_value)
+def test_hypothesis_byte_identical(obj):
+    lib = _native()
+    assert lib.canon_pack(obj) == _python_pack(obj)
+
+
+def test_codec_pack_routes_native():
+    # pack() itself (with the lazy native hook) agrees with the pure
+    # Python expression on a state-shaped object
+    obj = {b"c": {b"a%d" % i: i for i in range(100)},
+           b"e": {i: {b"x": i} for i in range(50)}, b"d": {}}
+    assert codec.pack(obj) == _python_pack(obj)
