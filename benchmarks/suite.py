@@ -377,42 +377,76 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
     # pack into one (R * V = 1M ≪ 2^31)
     n_values = int(value.max()) + 1
 
-    def make_chained(n):
-        @jax.jit
-        def run(key, hi, lo, actor, value):
-            win0 = (
-                jnp.full(K_keys, -1, jnp.int32),
-                jnp.full(K_keys, -1, jnp.int32),
-                jnp.full(K_keys, -1, jnp.int32),
-                jnp.full(K_keys, -1, jnp.int32),
-                jnp.zeros(K_keys, bool),
-            )
-
-            def body(carry, _):
-                # rotate the batch by a carry-derived offset: the fold is
-                # order-independent so the result is identical, but the
-                # inputs are loop-varying as far as XLA can tell, so the
-                # scatter passes cannot be hoisted out of the loop
-                # (measured un-anchored: marginal shrinks as N grows —
-                # the chain was timing only the elementwise compete)
-                off = jnp.abs(carry[0][0]) % jnp.int32(len(key))
-                rolled = [jnp.roll(x, off) for x in (key, hi, lo, actor, value)]
-                return (
-                    K.lww_fold_into(
-                        carry, *rolled,
-                        num_keys=K_keys, num_values=n_values,
-                    ),
-                    (),
+    def make_chained_impl(impl, tile_cap):
+        def make_chained(n):
+            @jax.jit
+            def run(key, hi, lo, actor, value):
+                win0 = (
+                    jnp.full(K_keys, -1, jnp.int32),
+                    jnp.full(K_keys, -1, jnp.int32),
+                    jnp.full(K_keys, -1, jnp.int32),
+                    jnp.full(K_keys, -1, jnp.int32),
+                    jnp.zeros(K_keys, bool),
                 )
 
-            carry, _ = jax.lax.scan(body, win0, None, length=n)
-            return carry
-        return lambda: run(*args)
+                def body(carry, _):
+                    # rotate the batch by a carry-derived offset: the fold
+                    # is order-independent so the result is identical, but
+                    # the inputs are loop-varying as far as XLA can tell,
+                    # so the scatter passes cannot be hoisted out of the
+                    # loop (measured un-anchored: marginal shrinks as N
+                    # grows — the chain was timing only the compete)
+                    off = jnp.abs(carry[0][0]) % jnp.int32(len(key))
+                    rolled = [
+                        jnp.roll(x, off)
+                        for x in (key, hi, lo, actor, value)
+                    ]
+                    return (
+                        K.lww_fold_into(
+                            carry, *rolled,
+                            num_keys=K_keys, num_values=n_values,
+                            impl=impl, tile_cap=tile_cap,
+                        ),
+                        (),
+                    )
+
+                carry, _ = jax.lax.scan(body, win0, None, length=n)
+                return carry
+            return lambda: run(*args)
+        return make_chained
 
     # NOTE: each chained fold competes N new rows + K_keys carried winners,
     # so device_rate = N / t_dev UNDERSTATES per-row throughput (by up to
     # ~2x when K_keys ≈ N) — conservative by construction.
-    t_dev, timing = timeit_marginal(make_chained, iters, chain=20 * cmul)
+    t_dev, timing = timeit_marginal(
+        make_chained_impl("xla", 0), iters, chain=20 * cmul
+    )
+    lww_variant = "xla_cascades"
+    if jax.default_backend() == "tpu":
+        # the Pallas winner fold (ops/pallas_lww.py): time it as a second
+        # variant and take the better, gated on exact equality with the
+        # XLA fold on the full batch (parity is also pinned in tests)
+        from crdt_enc_tpu.ops.pallas_lww import lww_fold_pallas, lww_tile_cap
+
+        cap = lww_tile_cap(key, K_keys)
+        ref_tbl = K.lww_fold(*args, num_keys=K_keys, num_values=n_values)
+        pal_tbl = lww_fold_pallas(
+            *args, num_keys=K_keys, num_values=n_values, tile_cap=cap
+        )
+        pallas_ok = all(
+            bool(jnp.array_equal(a, b)) for a, b in zip(ref_tbl, pal_tbl)
+        )
+        if pallas_ok:
+            t_pal, timing_pal = timeit_marginal(
+                make_chained_impl("pallas", cap), iters, chain=20 * cmul
+            )
+            log(f"  lww pallas marginal {t_pal * 1e3:.2f}ms vs xla "
+                f"{t_dev * 1e3:.2f}ms")
+            if t_pal < t_dev:
+                t_dev, timing, lww_variant = t_pal, timing_pal, "pallas_mxu"
+        else:
+            log("WARNING: pallas LWW fold diverged on the full batch; "
+                "excluded from timing")
 
     # The timed path is lww_fold_into: check IT (incremental, two halves)
     # against the whole-batch fold on the host subsample, then the whole
@@ -450,7 +484,8 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
         config="lwwmap_1Mx10k", metric="writes_folded_per_sec", N=N,
         K=K_keys, R=R,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
-        timing=timing, bytes_model=20 * N + 2 * 20 * K_keys,
+        timing=timing, variant=lww_variant,
+        bytes_model=20 * N + 2 * 20 * K_keys,
         **host_stats(host_times),
     )
 

@@ -101,7 +101,11 @@ def lww_table_merge(a: tuple, b: tuple) -> tuple:
     return (*out, a[4] | b[4])
 
 
-@partial(jax.jit, static_argnames=("num_keys", "num_values"))
+@partial(
+    jax.jit,
+    static_argnames=("num_keys", "num_values", "impl", "tile_cap",
+                     "interpret"),
+)
 def lww_fold_into(
     win: tuple,  # (win_hi, win_lo, win_actor, win_value, present) — (K,) each
     key: jax.Array,
@@ -112,6 +116,9 @@ def lww_fold_into(
     *,
     num_keys: int,
     num_values: int | None = None,
+    impl: str = "xla",  # "xla" (cascaded segment-max) | "pallas" (MXU)
+    tile_cap: int = 1 << 14,  # pallas only: ops/pallas_lww.lww_tile_cap
+    interpret: bool = False,
 ):
     """Incremental fold: new rows compete against an existing winner table.
 
@@ -120,9 +127,23 @@ def lww_fold_into(
     winners never re-enter the scatter path, so the incremental cost is
     the new rows plus one O(K) VPU pass.  The LWW tie-break is a total
     order, so ``fold_into(fold(A), B) == fold(A ++ B)`` (associativity) —
-    this is the merge step for folding op batches that arrive in waves."""
-    new = lww_fold(
-        key, ts_hi, ts_lo, actor, value,
-        num_keys=num_keys, num_values=num_values,
-    )
+    this is the merge step for folding op batches that arrive in waves.
+
+    ``impl="pallas"`` runs the new-row winner selection on the MXU
+    (ops/pallas_lww.py — requires ``num_values`` and ``ts_hi+1`` inside
+    int32, the caller's eligibility check); the merge is VPU either way.
+    """
+    if impl == "pallas":
+        from .pallas_lww import lww_fold_pallas
+
+        new = lww_fold_pallas(
+            key, ts_hi, ts_lo, actor, value,
+            num_keys=num_keys, num_values=num_values,
+            tile_cap=tile_cap, interpret=interpret,
+        )
+    else:
+        new = lww_fold(
+            key, ts_hi, ts_lo, actor, value,
+            num_keys=num_keys, num_values=num_values,
+        )
     return lww_table_merge(new, win)

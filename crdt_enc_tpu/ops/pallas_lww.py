@@ -1,0 +1,287 @@
+"""Pallas TPU kernel: the LWW winner-selection fold as sorted one-hot
+matmuls on the MXU (round 4) — the same reformulation that took the
+ORSet scatter onto the MXU (ops/pallas_fold.py), applied to config 4's
+scatter wall.
+
+``ops/lww.py lww_fold`` implements the per-key lexicographic argmax with
+3 cascaded ``segment_max`` scatters (~9ns/row each on TPU — the fold's
+entire marginal cost at the 1M-key shape).  This kernel replaces all
+three with ONE sort + one matmul materialization pass:
+
+1. **Sort** rows by ``(key, ts_hi, ts_lo, av)`` (4-operand XLA sort,
+   ``av = actor·V + value`` — the packed rank the XLA path also uses).
+   The LAST row of every key run is that key's lexicographic winner.
+2. **Emit columns**: non-winner rows' output columns are zeroed; the
+   ts columns emit raw values and the packed-rank column emits
+   ``av + 1`` — present-ness is carried by that column alone (``av+1``
+   cannot wrap int32 under the packed-rank bound, while a +1 on a full
+   31-bit timestamp would).  Each key now has AT MOST ONE nonzero row
+   per column, so a one-hot SUM materializes the winner table — and a
+   sum of one-hot rows is a matmul.
+3. **Kernel**, grid over 16384-key tiles: each SUB-row chunk builds one
+   transposed key one-hot ``A_T (128, SUB)`` (row = in-tile key >> 7)
+   shared by all columns, and per column a lane one-hot weighted by an
+   8-bit limb of the emitted value — ``(128, SUB) × (SUB, 128)`` MXU
+   contractions, 4 limbs per 32-bit column with high limbs skipped per
+   chunk when no row needs them (timestamps with small ``ts_hi`` and
+   packed ranks below 2^16 skip most of the work).
+4. The winner table decodes elementwise: ``present = out_av > 0``,
+   ``av = out_av - 1``, ``m_actor = av // V``, ``m_value = av % V`` —
+   exactly ``lww_fold``'s packed-cascade contract.
+
+Byte-level parity with ``lww_fold`` is pinned by
+tests/test_pallas_lww.py; the table-merge step (``lww_table_merge``)
+stays elementwise VPU work, so ``lww_fold_into`` composes unchanged.
+
+Reference analogue: the per-op hot loop crdt-enc/src/lib.rs:533-539
+(LWW values ride the same op files; the reference folds them one
+``state.apply`` at a time).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+SEG_KEYS = 128 * 128  # keys per grid tile
+SUB = 256  # rows per in-kernel matmul chunk
+MAX_ROWS = 1 << 22  # sort working-set bound, as in pallas_fold
+
+_LIMB = 8  # bits per one-hot matmul limb (exact in bf16/f32: limbs < 256)
+
+
+def _lww_tile_kernel(
+    edges_ref,  # scalar prefetch: (T+1,) per-tile row ranges
+    klo_ref, khi_ref,  # (1, BLK) windows of sorted keys
+    e1lo_ref, e1hi_ref, e2lo_ref, e2hi_ref, e3lo_ref, e3hi_ref,  # columns
+    out1_ref, out2_ref, out3_ref,  # (1, 128, 128) int32
+    *, BLK: int, dot_dtype,
+):
+    t = pl.program_id(0)
+    lo = edges_ref[t]
+    hi = edges_ref[t + 1]
+    w0 = (lo // BLK) * BLK
+
+    out1_ref[...] = jnp.zeros(out1_ref.shape, jnp.int32)
+    out2_ref[...] = jnp.zeros(out2_ref.shape, jnp.int32)
+    out3_ref[...] = jnp.zeros(out3_ref.shape, jnp.int32)
+
+    # one iota serves both one-hots: rows and lanes both index the
+    # sublane axis of a (128, SUB) comparison
+    iota128 = jax.lax.broadcasted_iota(jnp.int32, (LANE, SUB), 0)
+    pos_iota = jax.lax.broadcasted_iota(jnp.int32, (1, SUB), 1)
+    dims = (((1,), (1,)), ((), ()))
+
+    def load(ref_lo, ref_hi, local, in_hi):
+        return jax.lax.cond(
+            in_hi,
+            lambda: ref_hi[0, pl.ds(local, SUB)],
+            lambda: ref_lo[0, pl.ds(local, SUB)],
+        ).reshape(1, SUB)
+
+    def body(j, _):
+        off = pl.multiple_of(j * SUB, SUB)
+        local = off - w0
+        in_hi = local >= BLK
+        local = pl.multiple_of(jnp.where(in_hi, local - BLK, local), SUB)
+        k = load(klo_ref, khi_ref, local, in_hi)
+        pos = pos_iota + off
+        ok = (pos >= lo) & (pos < hi)
+        rel = k - t * SEG_KEYS
+        row = jnp.where(ok, rel >> 7, -1)
+        lane = jnp.where(ok, rel & (LANE - 1), -1)
+        A_T = (row == iota128).astype(dot_dtype)  # shared by all columns
+        hot = lane == iota128
+
+        def col(e_lo, e_hi, out_ref):
+            v = jnp.where(ok, load(e_lo, e_hi, local, in_hi), 0)
+            vmax = jnp.max(v)
+
+            def limb(shift):
+                piece = hot * ((v >> shift) & 0xFF).astype(dot_dtype)
+                p = jax.lax.dot_general(
+                    A_T, piece, dims, preferred_element_type=jnp.float32
+                )
+                return p.astype(jnp.int32) << shift
+
+            # limb 0 always; higher limbs only when some row needs them
+            acc = limb(0)
+            acc = jax.lax.cond(
+                vmax >= (1 << _LIMB),
+                lambda a: a + limb(_LIMB),
+                lambda a: a, acc,
+            )
+            acc = jax.lax.cond(
+                vmax >= (1 << (2 * _LIMB)),
+                lambda a: a + limb(2 * _LIMB),
+                lambda a: a, acc,
+            )
+            acc = jax.lax.cond(
+                vmax >= (1 << (3 * _LIMB)),
+                lambda a: a + limb(3 * _LIMB),
+                lambda a: a, acc,
+            )
+            out_ref[0] += acc
+
+        col(e1lo_ref, e1hi_ref, out1_ref)
+        col(e2lo_ref, e2hi_ref, out2_ref)
+        col(e3lo_ref, e3hi_ref, out3_ref)
+        return 0
+
+    start_j = lo // SUB
+    end_j = jnp.where(lo == hi, start_j, pl.cdiv(hi, SUB))
+    jax.lax.fori_loop(start_j, end_j, body, 0)
+
+
+def lww_fold_pallas(
+    key,  # (N,) int32   (== num_keys ⇒ padding row)
+    ts_hi,  # (N,) int32
+    ts_lo,  # (N,) int32
+    actor,  # (N,) int32  rank-interned
+    value,  # (N,) int32  rank-interned
+    *,
+    num_keys: int,
+    num_values: int,
+    tile_cap: int | None = None,  # ≥ max rows in any 16384-key tile
+    interpret: bool = False,
+):
+    """Drop-in for ``lww_fold(..., num_values=V)`` (same contract,
+    including the packed (actor, value) rank cascade — the caller
+    guarantees ``max_actor_rank · V + V < 2^31``).  Returns
+    ``(win_hi, win_lo, win_actor, win_value, present)``.
+
+    ``tile_cap`` bounds the kernel's sliding window; a cap below the
+    densest tile's row count silently drops rows, so concrete callers
+    get it computed (and a given one validated) here — callers inside a
+    jit trace MUST pass the correct static cap themselves
+    (``lww_tile_cap``)."""
+    import numpy as np
+
+    if not isinstance(key, jax.core.Tracer):
+        need = lww_tile_cap(np.asarray(key), num_keys)
+        if tile_cap is None:
+            tile_cap = need
+        elif tile_cap < need:
+            raise ValueError(
+                f"tile_cap={tile_cap} below the densest key tile ({need} "
+                "rows) — the sliding window would drop rows"
+            )
+    elif tile_cap is None:
+        raise ValueError(
+            "lww_fold_pallas under jit needs an explicit static tile_cap "
+            "(compute it host-side with lww_tile_cap)"
+        )
+    return _lww_fold_pallas_impl(
+        key, ts_hi, ts_lo, actor, value, num_keys=num_keys,
+        num_values=num_values, tile_cap=tile_cap, interpret=interpret,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_keys", "num_values", "tile_cap", "interpret"),
+)
+def _lww_fold_pallas_impl(
+    key, ts_hi, ts_lo, actor, value,
+    *, num_keys, num_values, tile_cap, interpret,
+):
+    K, V = num_keys, num_values
+    N = key.shape[0]
+    if N > MAX_ROWS:
+        raise ValueError(f"batch of {N} rows exceeds MAX_ROWS={MAX_ROWS}")
+    T = -(-K // SEG_KEYS)
+    sentinel = T * SEG_KEYS
+
+    pad = key >= K
+    key_ix = jnp.where(pad, sentinel, key)
+    av = actor * V + value
+    skey, s_hi, s_lo, s_av = jax.lax.sort(
+        (key_ix, ts_hi, ts_lo, av), num_keys=4
+    )
+    # Last of each key run is the lexicographic winner; everyone else
+    # emits 0.  Present-ness is carried by the av column ALONE: winners
+    # emit av+1 (safe — av ≤ R·V-1 ≤ 2^31-2 by the caller's packed-rank
+    # bound), while the ts columns emit their raw values, so a full
+    # 31-bit ts_hi/ts_lo cannot wrap (a +1 there overflowed int32 at
+    # ts_lo = 2^31-1, the maximum ts_split emits).
+    nxt = jnp.concatenate([skey[1:], jnp.full((1,), -1, skey.dtype)])
+    win = (skey != nxt) & (skey < sentinel)
+    e_hi = jnp.where(win, s_hi, 0)
+    e_lo = jnp.where(win, s_lo, 0)
+    e_av = jnp.where(win, s_av + 1, 0)
+
+    bounds = jnp.arange(T + 1, dtype=jnp.int32) * SEG_KEYS
+    edges = jnp.searchsorted(skey, bounds).astype(jnp.int32)
+
+    BLK = SUB
+    while BLK < tile_cap:
+        BLK *= 2
+    Np = (-(-N // BLK) + 1) * BLK
+
+    def padto(x, fill):
+        return jnp.concatenate(
+            [x, jnp.full((Np - N,), fill, jnp.int32)]
+        ).reshape(1, Np)
+
+    skey = padto(skey, sentinel)
+    e_hi = padto(e_hi, 0)
+    e_lo = padto(e_lo, 0)
+    e_av = padto(e_av, 0)
+
+    win_lo_spec = pl.BlockSpec(
+        (1, BLK), lambda t, e: (0, e[t] // BLK), memory_space=pltpu.VMEM
+    )
+    last_blk = Np // BLK - 1
+    win_hi_spec = pl.BlockSpec(
+        (1, BLK),
+        lambda t, e: (0, jnp.minimum(e[t] // BLK + 1, last_blk)),
+        memory_space=pltpu.VMEM,
+    )
+    out_spec = pl.BlockSpec(
+        (1, LANE, LANE), lambda t, e: (t, 0, 0), memory_space=pltpu.VMEM
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T,),
+        in_specs=[win_lo_spec, win_hi_spec] * 4,
+        out_specs=[out_spec] * 3,
+    )
+    out_hi, out_lo, out_av = pl.pallas_call(
+        partial(_lww_tile_kernel, BLK=BLK, dot_dtype=jnp.bfloat16),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((T, LANE, LANE), jnp.int32)] * 3,
+        interpret=interpret,
+    )(edges, skey, skey, e_hi, e_hi, e_lo, e_lo, e_av, e_av)
+
+    # (T, 128, 128) row-major ≡ (T·16384,): key order — free reshape
+    out_hi = out_hi.reshape(T * SEG_KEYS)[:K]
+    out_lo = out_lo.reshape(T * SEG_KEYS)[:K]
+    out_av = out_av.reshape(T * SEG_KEYS)[:K]
+    present = out_av > 0
+    m_hi = jnp.where(present, out_hi, -1)
+    m_lo = jnp.where(present, out_lo, -1)
+    av = out_av - 1
+    m_actor = jnp.where(present, av // V, -1)
+    m_value = jnp.where(present, av % V, -1)
+    return m_hi, m_lo, m_actor, m_value, present
+
+
+def lww_tile_cap(key, num_keys: int) -> int:
+    """Max row count over 16384-key tiles, bucketed to a power of two —
+    the kernel's sliding-window size (conservative: counts every row)."""
+    import numpy as np
+
+    T = max(-(-num_keys // SEG_KEYS), 1)
+    counts = np.bincount(
+        np.minimum(np.asarray(key) // SEG_KEYS, T - 1), minlength=T
+    )
+    need = int(counts.max(initial=0))
+    cap = SUB
+    while cap < need:
+        cap *= 2
+    return cap

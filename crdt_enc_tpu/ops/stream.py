@@ -111,15 +111,20 @@ def orset_fold_stream(
     add = jax.device_put(np.asarray(add0, np.int32))
     rm = jax.device_put(np.asarray(rm0, np.int32))
     if impl == "pallas":
-        from .pallas_fold import fold_cap
-
+        if tile_cap is None:
+            # a per-chunk fold_cap here would recompile the donated fold
+            # for every distinct cap — the caller computes ONE cap over
+            # the whole member column (which bounds every chunk's)
+            raise ValueError(
+                "impl='pallas' requires tile_cap (fold_cap over the whole "
+                "member column)"
+            )
         interpret = jax.default_backend() != "tpu"
         for kind, member, actor, counter in chunks:
-            cap = tile_cap or fold_cap(member, num_members)
             clock, add, rm = _fold_donated_pallas(
                 clock, add, rm, kind, member, actor, counter,
                 num_members=num_members, num_replicas=num_replicas,
-                tile_cap=cap, interpret=interpret,
+                tile_cap=tile_cap, interpret=interpret,
             )
         return clock, add, rm
     for kind, member, actor, counter in chunks:

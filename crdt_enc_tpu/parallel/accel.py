@@ -155,16 +155,12 @@ class TpuAccelerator(HostAccelerator):
                 # memory for arbitrarily large ingests (ops/stream.py).
                 # Chunks route through the Pallas MXU fold when eligible —
                 # the streaming path must run the same flagship kernel the
-                # dense path does.
-                import jax
-
+                # dense path does (chunk size == MAX_ROWS, so the row
+                # bound holds by construction here).
                 from ..ops import pallas_fold as PF
 
                 stream_kw = {}
-                if (
-                    jax.default_backend() == "tpu"
-                    and int(np.max(counter, initial=0)) < PF.MAX_COUNTER
-                ):
+                if self._pallas_eligible(counter):
                     stream_kw = dict(
                         impl="pallas", tile_cap=PF.fold_cap(member, E)
                     )
@@ -199,20 +195,48 @@ class TpuAccelerator(HostAccelerator):
         state.deferred = folded.deferred
         return state
 
+    @staticmethod
+    def _lww_pallas_eligible(num_values, ts_hi, n_rows: int) -> bool:
+        """Pallas LWW winner-fold precondition: real TPU, a packed
+        (actor, value) rank (num_values set — its +1 present-offset is
+        the only one the kernel applies, and it cannot wrap under the
+        packed-rank bound), rows inside the sort working set."""
+        import jax
+
+        from ..ops import pallas_lww as PL
+
+        return (
+            jax.default_backend() == "tpu"
+            and num_values is not None
+            and n_rows <= PL.MAX_ROWS
+        )
+
+    @staticmethod
+    def _pallas_eligible(counter) -> bool:
+        """Shared Pallas-fold precondition: real TPU hardware and every
+        counter inside the kernel's 7-bit-limb bound.  Row-count limits
+        are the caller's concern (the dense path checks MAX_ROWS, the
+        streaming path chunks at exactly that size)."""
+        import jax
+
+        from ..ops import pallas_fold as PF
+
+        return (
+            jax.default_backend() == "tpu"
+            and int(np.max(counter, initial=0)) < PF.MAX_COUNTER
+        )
+
     def _pick_dense_fold(self, cols, E: int, R: int):
         """The dense single-device fold kernel: the Pallas MXU fold when
         eligible on real TPU hardware (counters inside the 7-bit-limb
         bound, batch inside the sort working set — the same routing the
         bench publishes), else the XLA scatter fold.  The product ingest
         and the benchmark must run the same machinery."""
-        import jax
-
         from ..ops import pallas_fold as PF
 
         eligible = (
-            jax.default_backend() == "tpu"
-            and len(cols.kind) <= PF.MAX_ROWS
-            and int(np.max(cols.counter, initial=0)) < PF.MAX_COUNTER
+            len(cols.kind) <= PF.MAX_ROWS
+            and self._pallas_eligible(cols.counter)
         )
         if eligible:
             tile_cap = PF.fold_cap(cols.member, E)
@@ -711,10 +735,19 @@ class TpuAccelerator(HostAccelerator):
             # pack (actor, value) into one cascade when the rank product fits
             V = len(cols.values_sorted)
             num_values = V if len(cols.actors_sorted) * V < 2**31 else None
-            m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
-                key_col, hi, lo, actor_col, value_col,
-                num_keys=Kn, num_values=num_values,
-            )
+            if self._lww_pallas_eligible(num_values, hi, len(key_col)):
+                from ..ops.pallas_lww import lww_fold_pallas, lww_tile_cap
+
+                m_hi, m_lo, m_actor, m_value, present = lww_fold_pallas(
+                    key_col, hi, lo, actor_col, value_col,
+                    num_keys=Kn, num_values=num_values,
+                    tile_cap=lww_tile_cap(key_col, Kn),
+                )
+            else:
+                m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
+                    key_col, hi, lo, actor_col, value_col,
+                    num_keys=Kn, num_values=num_values,
+                )
         m_hi = np.asarray(m_hi)
         m_lo = np.asarray(m_lo)
         m_actor = np.asarray(m_actor)

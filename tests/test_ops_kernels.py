@@ -452,13 +452,16 @@ def test_orset_fold_stream_matches_whole_batch():
     assert canonical_bytes(streamed) == canonical_bytes(host)
 
     # the Pallas chunk route (interpret mode here; real MXU on TPU) must
-    # produce the same planes
+    # produce the same planes; one tile_cap over the whole member column
+    from crdt_enc_tpu.ops.pallas_fold import fold_cap
+
     clock, add, rm = K.orset_fold_stream(
         np.zeros(R, np.int32), np.zeros((E, R), np.int32),
         np.zeros((E, R), np.int32),
         K.iter_orset_chunks(cols.kind, cols.member, cols.actor, cols.counter,
                             chunk_rows=16, num_replicas=R),
         num_members=E, num_replicas=R, impl="pallas",
+        tile_cap=fold_cap(cols.member, E),
     )
     streamed_p = K.orset_planes_to_state(
         np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas

@@ -1,0 +1,122 @@
+"""Parity: the Pallas LWW winner-selection fold (ops/pallas_lww.py)
+must match the XLA cascade fold (ops/lww.py lww_fold) — which the
+accelerator/bench already pin byte-identical to the host LWWMap — on
+every shape the router can hand it.  Interpret mode on CPU; the MXU
+path is exercised by benchmarks/suite.py config 4 on TPU."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from crdt_enc_tpu.ops.lww import lww_fold, ts_split
+from crdt_enc_tpu.ops.pallas_lww import lww_fold_pallas, lww_tile_cap
+
+
+def _run_both(key, ts_hi, ts_lo, actor, value, K, V):
+    ref = lww_fold(
+        key, ts_hi, ts_lo, actor, value, num_keys=K, num_values=V
+    )
+    got = lww_fold_pallas(
+        key, ts_hi, ts_lo, actor, value, num_keys=K, num_values=V,
+        tile_cap=lww_tile_cap(key, K), interpret=True,
+    )
+    for r, g, name in zip(ref, got, ("hi", "lo", "actor", "value", "present")):
+        np.testing.assert_array_equal(
+            np.asarray(r), np.asarray(g), err_msg=name
+        )
+
+
+def _gen(N, K, R, V, seed, ts_max=10 ** 12, pad_frac=0.05):
+    rng = np.random.default_rng(seed)
+    key = rng.integers(0, K, N, dtype=np.int32)
+    key = np.where(rng.random(N) < pad_frac, K, key).astype(np.int32)
+    hi, lo = ts_split(rng.integers(0, ts_max, N))
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    value = rng.integers(0, V, N, dtype=np.int32)
+    return key, hi, lo, actor, value
+
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize(
+    "N,K,R,V",
+    [
+        (500, 300, 20, 10),       # K < one tile
+        (800, 16384, 8, 5),       # K == exactly one tile
+        (1200, 20000, 30, 50),    # two tiles, second partial
+        (300, 40000, 4, 3),       # sparse keys across three tiles
+    ],
+)
+def test_parity_random(N, K, R, V, seed):
+    _run_both(*_gen(N, K, R, V, seed), K, V)
+
+
+def test_parity_heavy_ties():
+    # many rows share (key, ts): the tie must resolve by packed
+    # (actor, value) rank identically in both folds
+    K, R, V = 64, 6, 4
+    rng = np.random.default_rng(9)
+    N = 600
+    key = rng.integers(0, K, N, dtype=np.int32)
+    hi = np.zeros(N, np.int32)
+    lo = rng.integers(0, 3, N, dtype=np.int32)  # heavy ts collisions
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    value = rng.integers(0, V, N, dtype=np.int32)
+    _run_both(key, hi, lo, actor, value, K, V)
+
+
+def test_parity_zero_ts_and_all_pad():
+    # ts == 0 is a real timestamp; present-ness must not be confused
+    # with the zero emitted by absent keys
+    K, V = 10, 3
+    key = np.array([0, 3, 10, 10], np.int32)  # two pad rows
+    hi = np.zeros(4, np.int32)
+    lo = np.zeros(4, np.int32)
+    actor = np.array([1, 0, 0, 0], np.int32)
+    value = np.array([2, 1, 0, 0], np.int32)
+    _run_both(key, hi, lo, actor, value, K, V)
+    # all padding: every key absent
+    allpad = np.full(8, K, np.int32)
+    _run_both(allpad, np.zeros(8, np.int32), np.zeros(8, np.int32),
+              np.zeros(8, np.int32), np.zeros(8, np.int32), K, V)
+
+
+def test_parity_ts_lo_saturated():
+    # ts_lo == 2^31 - 1 (the max ts_split emits): a +1 present-offset on
+    # the ts columns wrapped int32 here — present-ness must ride the
+    # packed-rank column only (review finding, round 4)
+    K, V = 8, 3
+    hi31 = (1 << 31) - 1
+    key = np.array([0, 0, 5], np.int32)
+    hi = np.array([0, 7, hi31], np.int32)
+    lo = np.array([hi31, hi31, hi31], np.int32)
+    actor = np.array([1, 0, 0], np.int32)
+    value = np.array([2, 1, 0], np.int32)
+    _run_both(key, hi, lo, actor, value, K, V)
+
+
+def test_parity_large_ts_hi_limbs():
+    # timestamps big enough that every limb of ts_hi engages
+    K, R, V = 128, 5, 7
+    rng = np.random.default_rng(11)
+    N = 400
+    key = rng.integers(0, K, N, dtype=np.int32)
+    hi, lo = ts_split(rng.integers(2 ** 55, 2 ** 61, N))
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    value = rng.integers(0, V, N, dtype=np.int32)
+    _run_both(key, hi, lo, actor, value, K, V)
+
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    seed=st.integers(0, 2 ** 16),
+    n=st.integers(1, 400),
+    k=st.integers(1, 40000),
+    r=st.integers(1, 40),
+    v=st.integers(1, 40),
+)
+def test_parity_hypothesis(seed, n, k, r, v):
+    _run_both(*_gen(n, k, r, v, seed), k, v)
