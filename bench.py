@@ -597,6 +597,8 @@ def main():
     # tunnel outage cannot erase this round's verified numbers.  Only
     # real-TPU runs go into the committed evidence file — CPU smoke runs
     # would pollute it (override with BENCH_LOCAL_ALL=1 for testing).
+    if os.environ.get("BENCH_LOCAL_DISABLE") == "1":  # e.g. harness tests
+        return
     if dev.platform != "tpu" and os.environ.get("BENCH_LOCAL_ALL") != "1":
         return
     _append_local({

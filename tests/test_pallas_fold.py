@@ -137,8 +137,9 @@ def test_parity_exact_blk_multiple_with_empty_trailing_tile():
 @pytest.mark.parametrize(
     "R",
     [
-        1200,  # H=10 → H_BLK=16, Hp=16, A_BLK=1 (padded hi rows)
-        2500,  # H=20 → Hp=32, A_BLK=2: multi actor-block segments
+        1200,   # H=10 → H_BLK=16, Hp=16, A_BLK=1 (padded hi rows)
+        2500,   # H=20 → Hp=32, A_BLK=2: multi actor-block segments
+        10000,  # H=79 → Hp=80, A_BLK=5: the north-star bench geometry
     ],
 )
 def test_parity_large_R_actor_blocks(R):
