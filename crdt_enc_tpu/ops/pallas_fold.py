@@ -497,7 +497,7 @@ def orset_fold_pallas(
     *,
     num_members: int,
     num_replicas: int,
-    tile_cap: int = 1 << 14,  # ≥ max op rows in any 8-member tile (fold_cap)
+    tile_cap: int | None = None,  # ≥ max op rows in any 8-member tile
     retire_rm: bool = True,
     dot_impl: str = "bf16",  # "bf16" (always exact ≤ 2^14); "int8" reserved
     interpret: bool = False,
@@ -507,12 +507,33 @@ def orset_fold_pallas(
     normalized output) with the scatter phase on the MXU.  Handles any
     member-tile skew (loop bounds come from the sorted ranges, not a
     padded per-tile capacity); batches beyond ``MAX_ROWS`` must be
-    chunked by the caller (the sorted columns are held in VMEM whole)."""
+    chunked by the caller (the sorted columns are held in VMEM whole).
+
+    ``tile_cap`` bounds the sliding window; a cap below the densest
+    tile's row count would silently drop rows, so concrete callers get
+    it computed (and a given one validated) here — callers inside a jit
+    trace MUST pass the correct static cap themselves (``fold_cap``)."""
     E, R = num_members, num_replicas
     N = kind.shape[0]
     if N > MAX_ROWS:
         raise ValueError(
             f"batch of {N} rows exceeds MAX_ROWS={MAX_ROWS}; chunk it"
+        )
+    if not isinstance(member, jax.core.Tracer):
+        import numpy as _np
+
+        need = fold_cap(_np.asarray(member), E)
+        if tile_cap is None:
+            tile_cap = need
+        elif tile_cap < need:
+            raise ValueError(
+                f"tile_cap={tile_cap} below the densest member tile "
+                f"({need} rows) — the sliding window would drop rows"
+            )
+    elif tile_cap is None:
+        raise ValueError(
+            "orset_fold_pallas under jit needs an explicit static "
+            "tile_cap (compute it host-side with fold_cap)"
         )
     Ep = -(-E // TILE_E) * TILE_E
     # both layouts' key spaces are ~2·Ep·(R padded): guard int32
