@@ -126,21 +126,39 @@ def decrypt_blobs_packed(key: bytes, blobs: list, n_threads: int = 0):
     if n_threads <= 0:
         n_threads = min(32, os.cpu_count() or 1)
 
-    boffs = np.zeros(n + 1, np.uint64)
-    blens = np.fromiter((len(b) for b in blobs), np.uint64, count=n)
-    np.cumsum(blens, out=boffs[1:])
-    big = b"".join(blobs)
-    bp, _b = native.in_ptr(big)
     nonce_offs = np.zeros(n, np.uint64)
     ct_offs = np.zeros(n, np.uint64)
     ct_lens = np.zeros(n, np.uint64)
     vp, _v = native.in_ptr(XCHACHA_DATA_VERSION_1)
-    total_clear = int(lib.encbox_parse_batch(
-        bp, boffs.ctypes.data_as(native.u64p), n, vp,
-        nonce_offs.ctypes.data_as(native.u64p),
-        ct_offs.ctypes.data_as(native.u64p),
-        ct_lens.ctypes.data_as(native.u64p),
-    ))
+    blens = np.fromiter((len(b) for b in blobs), np.uint64, count=n)
+    all_bytes = all(type(b) is bytes for b in blobs)
+    if all_bytes:
+        # pointer-array parse: blobs stay in their own buffers — no join
+        # of the whole batch (a pure memcpy that cost ~40ms per 60MB on
+        # this host).  The parse emits ABSOLUTE addresses; the scatter
+        # below resolves them against a NULL base.
+        import ctypes
+
+        ptrs = (ctypes.c_char_p * n)(*blobs)
+        total_clear = int(lib.encbox_parse_batch_ptrs(
+            ptrs, blens.ctypes.data_as(native.u64p), n, vp,
+            nonce_offs.ctypes.data_as(native.u64p),
+            ct_offs.ctypes.data_as(native.u64p),
+            ct_lens.ctypes.data_as(native.u64p),
+        ))
+        bp = ctypes.cast(0, native.u8p)
+        _b = blobs  # keep every blob alive through the scatter call
+    else:
+        boffs = np.zeros(n + 1, np.uint64)
+        np.cumsum(blens, out=boffs[1:])
+        big = b"".join(blobs)
+        bp, _b = native.in_ptr(big)
+        total_clear = int(lib.encbox_parse_batch(
+            bp, boffs.ctypes.data_as(native.u64p), n, vp,
+            nonce_offs.ctypes.data_as(native.u64p),
+            ct_offs.ctypes.data_as(native.u64p),
+            ct_lens.ctypes.data_as(native.u64p),
+        ))
     if total_clear >= 0:
         out_offs = np.zeros(n, np.uint64)
         np.cumsum(ct_lens[:-1] - TAG_LEN, out=out_offs[1:])

@@ -146,6 +146,11 @@ def _bind(lib) -> None:
         u8p, u64p, ctypes.c_uint64, u8p, u64p, u64p, u64p
     ]
     lib.encbox_parse_batch.restype = ctypes.c_int64
+    lib.encbox_parse_batch_ptrs.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), u64p, ctypes.c_uint64, u8p,
+        u64p, u64p, u64p,
+    ]
+    lib.encbox_parse_batch_ptrs.restype = ctypes.c_int64
     lib.encbox_decrypt_scatter_mt.argtypes = [
         u8p, u8p, u64p, u64p, u64p, ctypes.c_uint64, u8p, u64p, u8p,
         ctypes.c_int,

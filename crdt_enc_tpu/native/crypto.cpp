@@ -670,6 +670,45 @@ int64_t encbox_parse_batch(const uint8_t* blobs, const uint64_t* boffs,
   return total;
 }
 
+// Resolve a blob location from a base pointer plus offset.  The
+// pointer-array parse (encbox_parse_batch_ptrs) emits ABSOLUTE
+// addresses paired with a NULL base — go through uintptr_t so that
+// case is defined behavior, not nullptr arithmetic.
+static inline const uint8_t* blob_at(const uint8_t* base, uint64_t off) {
+  return (const uint8_t*)((uintptr_t)base + (uintptr_t)off);
+}
+
+// Pointer-array variant: blobs live in SEPARATE buffers (the usual case
+// — per-file bytes straight from storage), so no caller-side join of
+// hundreds of MB is needed.  Emits ABSOLUTE addresses into
+// nonce_offs/ct_offs; pair with encbox_decrypt_scatter_mt(blobs=NULL),
+// whose `blobs + off` arithmetic then resolves each address unchanged.
+int64_t encbox_parse_batch_ptrs(const uint8_t* const* blob_ptrs,
+                                const uint64_t* blob_lens, uint64_t n,
+                                const uint8_t* version, uint64_t* nonce_offs,
+                                uint64_t* ct_offs, uint64_t* ct_lens) {
+  int64_t total = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    const uint8_t* p = blob_ptrs[i];
+    const uint8_t* end = p + blob_lens[i];
+    if (end - p < 16 + 1) return -1;
+    if (memcmp(p, version, 16) != 0) return -1;
+    p += 16;
+    if (*p++ != 0x92) return -1;  // fixarray(2)
+    const uint8_t *nonce, *ct, *next;
+    uint64_t nonce_len, ct_len;
+    if (parse_bin(p, end, &nonce, &nonce_len, &next) != 0) return -1;
+    if (nonce_len != 24) return -1;
+    if (parse_bin(next, end, &ct, &ct_len, &next) != 0) return -1;
+    if (ct_len < 16 || next != end) return -1;
+    nonce_offs[i] = (uint64_t)(uintptr_t)nonce;
+    ct_offs[i] = (uint64_t)(uintptr_t)ct;
+    ct_lens[i] = ct_len;
+    total += (int64_t)(ct_len - 16);
+  }
+  return total;
+}
+
 // Threaded batch decrypt reading nonce/ct in place via the offsets the
 // parse produced — zero intermediate copies.  Output spans are disjoint
 // (out_offs from an exclusive scan of ct_lens-16).  Returns failure count.
@@ -757,12 +796,12 @@ static int encbox_decrypt_batched(const uint8_t* key, const uint8_t* blobs,
     const uint8_t* np[16];
     uint8_t(*sk)[32] = (uint8_t(*)[32])subkeys[i].data();
     for (int j = 0; j < 16; j++)
-      np[j] = blobs + nonce_offs[i + (j < c ? j : 0)];
+      np[j] = blob_at(blobs, nonce_offs[i + (j < c ? j : 0)]);
     hchacha20_x16(key, np, sk, c);
   }
   for (uint64_t i = 0; i < n; i++) {
     memset(n12[i].data(), 0, 4);
-    memcpy(n12[i].data() + 4, blobs + nonce_offs[i] + 16, 8);
+    memcpy(n12[i].data() + 4, blob_at(blobs, nonce_offs[i]) + 16, 8);
   }
   // phase 2: Poly1305 one-time keys (block 0 of each file's stream)
   for (uint64_t i = 0; i < n; i += 16) {
@@ -789,7 +828,7 @@ static int encbox_decrypt_batched(const uint8_t* key, const uint8_t* blobs,
       continue;
     }
     uint64_t data_len = ct_lens[i] - 16;
-    const uint8_t* ct = blobs + ct_offs[i];
+    const uint8_t* ct = blob_at(blobs, ct_offs[i]);
     Poly1305 p;
     p.init(otk[i].data());
     static const uint8_t zeros[16] = {0};
@@ -834,7 +873,7 @@ static int encbox_decrypt_batched(const uint8_t* key, const uint8_t* blobs,
       uint64_t data_len = ct_lens[jb.file] - 16;
       uint64_t off = (uint64_t)(jb.ctr - 1) * 64;
       uint64_t m = data_len - off < 64 ? data_len - off : 64;
-      const uint8_t* src = blobs + ct_offs[jb.file] + off;
+      const uint8_t* src = blob_at(blobs, ct_offs[jb.file]) + off;
       uint8_t* dst = out + out_offs[jb.file] + off;
       for (uint64_t b = 0; b < m; b++) dst[b] = src[b] ^ ks[j][b];
     }
@@ -860,7 +899,8 @@ int encbox_decrypt_scatter_mt(const uint8_t* key, const uint8_t* blobs,
     int f = 0;
     for (uint64_t i = lo; i < hi; i++) {
       int rc = xchacha20poly1305_decrypt(
-          key, blobs + nonce_offs[i], nullptr, 0, blobs + ct_offs[i],
+          key, blob_at(blobs, nonce_offs[i]), nullptr, 0,
+          blob_at(blobs, ct_offs[i]),
           ct_lens[i], out + out_offs[i]);
       ok_flags[i] = rc == 0 ? 1 : 0;
       if (rc != 0) f++;

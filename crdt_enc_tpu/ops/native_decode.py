@@ -38,6 +38,22 @@ def decode_orset_payload_batch(payloads: list, actors_sorted: list):
     return combine_orset_spans([part])
 
 
+def _shared_buffer_of(payloads):
+    """The single object every memoryview payload slices, or None.
+
+    The batch decrypt hands out zero-copy views of one cleartext buffer
+    (``decrypt_blobs``); spotting that here lets the decoder skip
+    re-joining what is already contiguous memory."""
+    first = payloads[0] if payloads else None
+    if type(first) is not memoryview:
+        return None
+    obj = first.obj
+    for p in payloads:
+        if type(p) is not memoryview or p.obj is not obj or not p.contiguous:
+            return None
+    return obj
+
+
 def decode_orset_payload_spans(payloads, actors_sorted: list, cache=None):
     """Native two-pass decode of one payload chunk to raw span columns.
 
@@ -76,10 +92,23 @@ def decode_orset_payload_spans(payloads, actors_sorted: list, cache=None):
         bases = offs[:-1].astype(np.uint64, copy=True)
         lens = np.diff(offs).astype(np.uint64)
     else:
-        big = b"".join(payloads)
-        lens = np.array([len(p) for p in payloads], np.uint64)
-        bases = np.zeros(n_payloads, np.uint64)
-        np.cumsum(lens[:-1], out=bases[1:])
+        big = _shared_buffer_of(payloads)
+        if big is not None:
+            # every payload is a view into ONE buffer (the batch
+            # decrypt's packed cleartext): address arithmetic recovers
+            # the offsets — no join of the whole chunk
+            lens = np.array([len(p) for p in payloads], np.uint64)
+            base0 = np.frombuffer(big, np.uint8).ctypes.data
+            bases = np.fromiter(
+                (np.frombuffer(p, np.uint8).ctypes.data - base0
+                 for p in payloads),
+                np.uint64, count=n_payloads,
+            )
+        else:
+            big = b"".join(payloads)
+            lens = np.array([len(p) for p in payloads], np.uint64)
+            bases = np.zeros(n_payloads, np.uint64)
+            np.cumsum(lens[:-1], out=bases[1:])
     buf = np.frombuffer(big, np.uint8)
     bp = buf.ctypes.data_as(native.u8p)
     if cache is not None and "actors" in cache:

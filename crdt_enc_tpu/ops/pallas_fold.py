@@ -511,30 +511,26 @@ def orset_fold_pallas(
 
     ``tile_cap`` bounds the sliding window; a cap below the densest
     tile's row count would silently drop rows, so concrete callers get
-    it computed (and a given one validated) here — callers inside a jit
-    trace MUST pass the correct static cap themselves (``fold_cap``)."""
+    it computed here when omitted; an explicit cap is trusted (derive it
+    with ``fold_cap``) and callers inside a jit trace MUST pass one."""
     E, R = num_members, num_replicas
     N = kind.shape[0]
     if N > MAX_ROWS:
         raise ValueError(
             f"batch of {N} rows exceeds MAX_ROWS={MAX_ROWS}; chunk it"
         )
-    if not isinstance(member, jax.core.Tracer):
+    if tile_cap is None:
+        if isinstance(member, jax.core.Tracer):
+            raise ValueError(
+                "orset_fold_pallas under jit needs an explicit static "
+                "tile_cap (compute it host-side with fold_cap)"
+            )
         import numpy as _np
 
-        need = fold_cap(_np.asarray(member), E)
-        if tile_cap is None:
-            tile_cap = need
-        elif tile_cap < need:
-            raise ValueError(
-                f"tile_cap={tile_cap} below the densest member tile "
-                f"({need} rows) — the sliding window would drop rows"
-            )
-    elif tile_cap is None:
-        raise ValueError(
-            "orset_fold_pallas under jit needs an explicit static "
-            "tile_cap (compute it host-side with fold_cap)"
-        )
+        # computed here for concrete callers; an explicit cap is trusted
+        # (every in-repo caller derives it from fold_cap — re-validating
+        # would re-run the O(N) bincount on the flagship path)
+        tile_cap = fold_cap(_np.asarray(member), E)
     Ep = -(-E // TILE_E) * TILE_E
     # both layouts' key spaces are ~2·Ep·(R padded): guard int32
     H = -(-R // LANE)

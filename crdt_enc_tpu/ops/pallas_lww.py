@@ -157,25 +157,21 @@ def lww_fold_pallas(
 
     ``tile_cap`` bounds the kernel's sliding window; a cap below the
     densest tile's row count silently drops rows, so concrete callers
-    get it computed (and a given one validated) here — callers inside a
-    jit trace MUST pass the correct static cap themselves
-    (``lww_tile_cap``)."""
+    get it computed here when omitted; an explicit cap is trusted
+    (derive it with ``lww_tile_cap``) and callers inside a jit trace
+    MUST pass one."""
     import numpy as np
 
-    if not isinstance(key, jax.core.Tracer):
-        need = lww_tile_cap(np.asarray(key), num_keys)
-        if tile_cap is None:
-            tile_cap = need
-        elif tile_cap < need:
+    if tile_cap is None:
+        if isinstance(key, jax.core.Tracer):
             raise ValueError(
-                f"tile_cap={tile_cap} below the densest key tile ({need} "
-                "rows) — the sliding window would drop rows"
+                "lww_fold_pallas under jit needs an explicit static "
+                "tile_cap (compute it host-side with lww_tile_cap)"
             )
-    elif tile_cap is None:
-        raise ValueError(
-            "lww_fold_pallas under jit needs an explicit static tile_cap "
-            "(compute it host-side with lww_tile_cap)"
-        )
+        # computed here for concrete callers; an explicit cap is trusted
+        # (in-repo callers derive it from lww_tile_cap — re-validating
+        # would re-run the O(N) bincount per fold)
+        tile_cap = lww_tile_cap(np.asarray(key), num_keys)
     return _lww_fold_pallas_impl(
         key, ts_hi, ts_lo, actor, value, num_keys=num_keys,
         num_values=num_values, tile_cap=tile_cap, interpret=interpret,

@@ -88,6 +88,23 @@ def test_orset_decode_rejects_malformed():
     assert lib.orset_count_rows(tp, len(trunc)) == -1
 
 
+def test_orset_decode_truncated_counter_uint16_member():
+    # Fast-path OOB regression (round-4 review): a payload whose last op
+    # has a uint16 member id and is truncated right before the counter
+    # passes the fast path's 24-byte entry guard but leaves the counter
+    # byte exactly at the buffer end — the decoder must decline, not
+    # read past it.
+    from crdt_enc_tpu.ops.native_decode import decode_orset_payload_spans
+
+    actors = [b"a" * 16]
+    # 0x91 (array-1) + 93 00 cd XXXX 92 c4 10 <16B actor>, counter missing
+    payload = bytes(
+        [0x91, 0x93, 0x00, 0xCD, 0x01, 0x00, 0x92, 0xC4, 0x10]
+    ) + actors[0]
+    assert len(payload) - 1 == 24  # exactly the fast-path entry guard
+    assert decode_orset_payload_spans([payload], actors) is None
+
+
 def test_counter_decode_matches_python():
     state = PNCounter()
     ops = []
