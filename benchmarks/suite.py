@@ -704,13 +704,32 @@ def main():
         results.append(r)
         print(json.dumps(r), flush=True)
     ok = all(r["byte_equal"] for r in results)
-    print(json.dumps({
+    summary = {
         "suite": "baseline_configs", "device": str(dev.device_kind),
         "configs_run": wanted, "all_byte_equal": ok,
         "geomean_speedup": round(
             float(np.exp(np.mean(np.log(ratios)))), 2
         ) if ratios else None,
-    }))
+    }
+    print(json.dumps(summary))
+    # real-TPU runs persist to the committed evidence file (same policy
+    # as bench.py's BENCH_LOCAL.jsonl): a capture-time tunnel outage
+    # must not erase in-round suite results
+    if dev.platform == "tpu":
+        import datetime
+
+        rec = {
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"),
+            **summary,
+            "results": results,
+        }
+        try:
+            path = Path(__file__).resolve().parent.parent / "SUITE_LOCAL.jsonl"
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except (OSError, TypeError, ValueError) as e:
+            log(f"WARNING: could not append SUITE_LOCAL.jsonl: {e!r}")
 
 
 if __name__ == "__main__":
