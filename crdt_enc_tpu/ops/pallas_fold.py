@@ -381,7 +381,38 @@ def _fold_ablk(
     *, num_members, num_replicas, tile_cap, retire_rm, dot_impl, interpret,
     sub_rows=SUB_ABLK,
 ):
+    add_new, rm_new = orset_scatter_pallas(
+        kind, member, actor, counter, num_members=num_members,
+        num_replicas=num_replicas, tile_cap=tile_cap, dot_impl=dot_impl,
+        interpret=interpret, sub_rows=sub_rows,
+    )
+    return _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm)
+
+
+def orset_scatter_pallas(
+    kind, member, actor, counter,
+    *, num_members, num_replicas, tile_cap, dot_impl="bf16",
+    interpret=False, sub_rows=SUB_ABLK,
+):
+    """The ablk layout's scatter phase alone: raw segment-max planes
+    ``(add_new, rm_new)`` with no replay gate or normalization.  The
+    sharded fold (parallel/mesh.py) calls this per device inside
+    shard_map — partials combine across ``dp`` with a ``pmax`` and the
+    normalize tail runs once after — so a mesh compaction runs the same
+    flagship kernel as a single chip.  Traceable (no data-dependent
+    Python); ``tile_cap`` must be the caller's static bound."""
     E, R = num_members, num_replicas
+    _g_Ep = -(-E // TILE_E) * TILE_E
+    _g_H = -(-R // LANE)
+    _g_Hb = 16 if _g_H > 8 else 8
+    _g_Hp = -(-_g_H // _g_Hb) * _g_Hb
+    if 2 * _g_Ep * _g_Hp * LANE >= 2 ** 31:
+        # the front door (orset_fold_pallas) reroutes to the wide layout
+        # past this bound; direct callers (the sharded fold) must gate
+        raise ValueError(
+            f"E={E}, R={R} overflows the ablk layout's int32 segment "
+            "keys; route this shape to the XLA fold"
+        )
     Ep = -(-E // TILE_E) * TILE_E
     T = Ep // TILE_E
     H = -(-R // LANE)
@@ -469,7 +500,7 @@ def _fold_ablk(
     # (T, 8·Hp, 128) row-major ≡ (Ep, Hp·128) row-major: free reshape
     add_new = out_add.reshape(Ep, Hp * LANE)[:E, :R]
     rm_new = out_rm.reshape(Ep, Hp * LANE)[:E, :R]
-    return _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm)
+    return add_new, rm_new
 
 
 def _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm):

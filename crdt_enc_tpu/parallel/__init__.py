@@ -13,6 +13,7 @@ from .mesh import (
     orset_merge_sharded,
     pad_rows_for_mesh,
     pncounter_fold_sharded,
+    sharded_fold_cap,
 )
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "orset_fold_sharded",
     "orset_merge_sharded",
     "pad_rows_for_mesh",
+    "sharded_fold_cap",
     "pncounter_fold_sharded",
     "replicate",
 ]

@@ -315,9 +315,30 @@ class TpuAccelerator(HostAccelerator):
         K.pad_orset_rows(
             cols, self._round_to(_bucket(len(cols.kind)), dp), R
         )
+        # each shard runs the flagship Pallas scatter when eligible — a
+        # mesh compaction must execute the same kernel a single chip does
+        fold_kw = {}
+        from ..ops import pallas_fold as PF
+
+        # int32 segment-key bound for the per-shard ablk kernel (the
+        # single-chip front door switches layouts past this; the sharded
+        # route has only the ablk layout, so it must stay on XLA there)
+        H = -(-R // 128)
+        H_blk = 16 if H > 8 else 8
+        Hp = -(-H // H_blk) * H_blk
+        Ep_local = -(-(E_pad // mp) // 8) * 8
+        if (
+            self._pallas_eligible(cols.counter)
+            and len(cols.kind) // dp <= PF.MAX_ROWS
+            and 2 * Ep_local * Hp * 128 < 2 ** 31
+        ):
+            fold_kw = dict(
+                impl="pallas",
+                tile_cap=pmesh.sharded_fold_cap(cols.member, E_pad, dp, mp),
+            )
         clock, add, rm = pmesh.orset_fold_sharded(
             mesh, clock0, add0, rm0,
-            cols.kind, cols.member, cols.actor, cols.counter,
+            cols.kind, cols.member, cols.actor, cols.counter, **fold_kw,
         )
         folded = K.orset_planes_to_state(
             np.asarray(clock), np.asarray(add)[:E], np.asarray(rm)[:E],

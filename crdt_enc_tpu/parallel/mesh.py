@@ -39,12 +39,18 @@ def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
     return Mesh(arr, axis_names=("dp", "mp"))
 
 
-def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R):
+def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R,
+                impl="xla", tile_cap=0, interpret=False):
     """Per-device body: fold this device's op rows into its member slice.
 
     ``member_lo`` is the first global member index of this device's slice;
     rows outside the slice are masked (they belong to a different mp shard).
     ``add0``/``rm0`` arrive as this device's (E_local, R) slice.
+
+    ``impl="pallas"`` runs the scatter phase through the flagship ablk
+    kernel (ops/pallas_fold.py orset_scatter_pallas) — a mesh compaction
+    then executes the same kernel a single chip does; the dp-pmax
+    combine and normalize tail are identical either way.
     """
     E_local = add0.shape[0]
     pad = actor >= R
@@ -55,15 +61,26 @@ def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R):
     actor_ix = jnp.minimum(actor, R - 1)
     member_ix = jnp.clip(local_member, 0, E_local - 1)
 
-    seg = member_ix * R + actor_ix
-    add_new = jax.ops.segment_max(
-        jnp.where(is_add, counter, 0), seg, num_segments=E_local * R
-    )
-    rm_new = jax.ops.segment_max(
-        jnp.where(is_rm, counter, 0), seg, num_segments=E_local * R
-    )
-    add_new = jnp.maximum(add_new, 0).reshape(E_local, R)
-    rm_new = jnp.maximum(rm_new, 0).reshape(E_local, R)
+    if impl == "pallas":
+        from ..ops.pallas_fold import orset_scatter_pallas
+
+        # out-of-slice rows become padding for this shard's kernel
+        shard_actor = jnp.where(in_slice & ~pad, actor, R)
+        add_new, rm_new = orset_scatter_pallas(
+            kind, member_ix, shard_actor, counter,
+            num_members=E_local, num_replicas=R, tile_cap=tile_cap,
+            interpret=interpret,
+        )
+    else:
+        seg = member_ix * R + actor_ix
+        add_new = jax.ops.segment_max(
+            jnp.where(is_add, counter, 0), seg, num_segments=E_local * R
+        )
+        rm_new = jax.ops.segment_max(
+            jnp.where(is_rm, counter, 0), seg, num_segments=E_local * R
+        )
+        add_new = jnp.maximum(add_new, 0).reshape(E_local, R)
+        rm_new = jnp.maximum(rm_new, 0).reshape(E_local, R)
     # cell-level replay gate (≡ row gating by per-actor dot monotonicity;
     # see ops/orset.py) — avoids a per-row clock gather on every shard
     add_new = jnp.where(add_new > clock0[None, :], add_new, 0)
@@ -98,6 +115,9 @@ def orset_fold_sharded(
     member,
     actor,
     counter,
+    impl: str = "xla",
+    tile_cap: int = 0,
+    interpret: bool = False,
 ):
     """Sharded ORSet fold.
 
@@ -105,6 +125,10 @@ def orset_fold_sharded(
     bucket-pad first); state planes sharded over ``mp`` on the member axis
     (E must divide by mp); the clock is replicated (it is O(R) and every
     shard updates it).  Returns (clock, add, rm) with the same shardings.
+
+    ``impl="pallas"``: each shard's scatter phase runs the flagship ablk
+    kernel (pass ``tile_cap`` from ``fold_cap`` over the WHOLE member
+    column — it bounds every shard's tiles).
     """
     dp = mesh.shape["dp"]
     mp = mesh.shape["mp"]
@@ -113,11 +137,17 @@ def orset_fold_sharded(
         raise ValueError(
             f"pad first: rows {len(kind)} % dp {dp} or members {E} % mp {mp}"
         )
+    if impl == "pallas" and not tile_cap:
+        raise ValueError(
+            "impl='pallas' requires tile_cap (fold_cap over the whole "
+            "member column)"
+        )
     E_local = E // mp
 
     def body(clock0, add0, rm0, kind, member, actor, counter, member_lo):
         return _local_fold(
-            clock0, add0, rm0, kind, member, actor, counter, member_lo[0], R
+            clock0, add0, rm0, kind, member, actor, counter, member_lo[0], R,
+            impl=impl, tile_cap=tile_cap, interpret=interpret,
         )
 
     # each mp shard needs its global member offset
@@ -155,6 +185,29 @@ def orset_merge_sharded(mesh: Mesh, clock_a, add_a, rm_a, clock_b, add_b, rm_b):
         check_vma=False,
     )
     return merge(clock_a, add_a, rm_a, clock_b, add_b, rm_b)
+
+
+def sharded_fold_cap(member, E_pad: int, dp: int, mp: int) -> int:
+    """``tile_cap`` for the pallas-sharded fold: the max op-row count over
+    any (dp shard, mp slice)-local 8-member tile, bucketed to a power of
+    two.  A global ``fold_cap`` does NOT bound this when ``E_pad/mp`` is
+    not a multiple of 8 (shard-local tiles straddle global ones), so the
+    count runs over the actual shard decomposition — dp row blocks are
+    contiguous, mp slices are contiguous member ranges."""
+    m = np.asarray(member, np.int64)
+    rows_per = max(len(m) // dp, 1)
+    E_local = E_pad // mp
+    T = max(-(-E_local // 8), 1)
+    # one pass: composite (dp block, mp slice, local tile) key per row
+    s = np.minimum(m // E_local, mp - 1)
+    tile = np.minimum((m - s * E_local) // 8, T - 1)
+    d = np.arange(len(m)) // rows_per
+    key = (d * mp + s) * T + tile
+    need = int(np.bincount(key).max(initial=0)) if len(m) else 0
+    cap = 256
+    while cap < need:
+        cap *= 2
+    return cap
 
 
 def pad_rows_for_mesh(cols, dp: int, num_replicas: int):

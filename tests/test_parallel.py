@@ -56,6 +56,31 @@ def test_sharded_fold_matches_host():
         assert canonical_bytes(device) == canonical_bytes(host), (dp, mp)
 
 
+def test_sharded_fold_pallas_matches_host():
+    """The pallas-sharded route (each shard's scatter on the flagship
+    kernel, interpret mode here) must match the host fold on every mesh
+    split — including mp slices whose member range is not 8-aligned."""
+    host, ops = build_history()
+    members, replicas = K.Vocab(list(range(16))), K.Vocab(ACTORS)
+    clock0, add0, rm0 = K.orset_state_to_planes(ORSet(), members, replicas)
+    E, R = len(members), len(replicas)
+
+    for dp, mp in [(4, 2), (2, 4), (1, 8)]:
+        mesh = par.make_mesh((dp, mp))
+        c2 = K.orset_ops_to_columns(ops, members, replicas)
+        c2 = par.pad_rows_for_mesh(c2, dp, R)
+        cap = par.sharded_fold_cap(c2.member, E, dp, mp)
+        clock, add, rm = par.orset_fold_sharded(
+            mesh, clock0, add0, rm0, c2.kind, c2.member, c2.actor,
+            c2.counter, impl="pallas", tile_cap=cap, interpret=True,
+        )
+        device = K.orset_planes_to_state(
+            np.asarray(clock), np.asarray(add), np.asarray(rm), members,
+            replicas,
+        )
+        assert canonical_bytes(device) == canonical_bytes(host), (dp, mp)
+
+
 def test_sharded_merge_matches_host():
     sa, _ = build_history(100)
     sb, _ = build_history(80)
