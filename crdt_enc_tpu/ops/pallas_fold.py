@@ -402,11 +402,7 @@ def orset_scatter_pallas(
     flagship kernel as a single chip.  Traceable (no data-dependent
     Python); ``tile_cap`` must be the caller's static bound."""
     E, R = num_members, num_replicas
-    _g_Ep = -(-E // TILE_E) * TILE_E
-    _g_H = -(-R // LANE)
-    _g_Hb = 16 if _g_H > 8 else 8
-    _g_Hp = -(-_g_H // _g_Hb) * _g_Hb
-    if 2 * _g_Ep * _g_Hp * LANE >= 2 ** 31:
+    if not ablk_key_space_fits(E, R):
         # the front door (orset_fold_pallas) reroutes to the wide layout
         # past this bound; direct callers (the sharded fold) must gate
         raise ValueError(
@@ -562,13 +558,10 @@ def orset_fold_pallas(
         # (every in-repo caller derives it from fold_cap — re-validating
         # would re-run the O(N) bincount on the flagship path)
         tile_cap = fold_cap(_np.asarray(member), E)
-    Ep = -(-E // TILE_E) * TILE_E
     # both layouts' key spaces are ~2·Ep·(R padded): guard int32
-    H = -(-R // LANE)
-    H_BLK = 16 if H > 8 else 8
-    Hp = -(-H // H_BLK) * H_BLK
-    if layout == "ablk" and 2 * Ep * Hp * LANE >= 2 ** 31:
+    if layout == "ablk" and not ablk_key_space_fits(E, R):
         layout = "wide"  # tighter padding; its own guard below
+    Ep = -(-E // TILE_E) * TILE_E
     if (Ep // TILE_E) * (2 * TILE_E * R) + 2 * TILE_E * R >= 2 ** 31:
         raise ValueError("E·R too large for int32 segment keys; shard first")
     kw = dict(
@@ -579,6 +572,17 @@ def orset_fold_pallas(
     if layout == "wide":
         return _fold_wide(*args, **kw)
     return _fold_ablk(*args, **kw)
+
+
+def ablk_key_space_fits(num_members: int, num_replicas: int) -> bool:
+    """Whether the ablk layout's int32 segment keys can encode (E, R) —
+    the ONE predicate every routing site must use (the front door, the
+    sharded fold's eligibility gate, the streaming session)."""
+    Ep = -(-num_members // TILE_E) * TILE_E
+    H = -(-num_replicas // LANE)
+    H_blk = 16 if H > 8 else 8
+    Hp = -(-H // H_blk) * H_blk
+    return 2 * Ep * Hp * LANE < 2 ** 31
 
 
 def fold_cap(member, num_members: int) -> int:

@@ -47,19 +47,20 @@ def _fold_donated(
 
 @partial(
     jax.jit,
-    static_argnames=("num_members", "num_replicas", "tile_cap", "interpret"),
+    static_argnames=("num_members", "num_replicas", "tile_cap", "interpret",
+                     "retire_rm"),
     donate_argnums=(0, 1, 2),
 )
 def _fold_donated_pallas(
     clock, add, rm, kind, member, actor, counter,
-    *, num_members, num_replicas, tile_cap, interpret,
+    *, num_members, num_replicas, tile_cap, interpret, retire_rm=True,
 ):
     from .pallas_fold import orset_fold_pallas
 
     return orset_fold_pallas(
         clock, add, rm, kind, member, actor, counter,
         num_members=num_members, num_replicas=num_replicas,
-        tile_cap=tile_cap, interpret=interpret,
+        tile_cap=tile_cap, interpret=interpret, retire_rm=retire_rm,
     )
 
 

@@ -323,14 +323,10 @@ class TpuAccelerator(HostAccelerator):
         # int32 segment-key bound for the per-shard ablk kernel (the
         # single-chip front door switches layouts past this; the sharded
         # route has only the ablk layout, so it must stay on XLA there)
-        H = -(-R // 128)
-        H_blk = 16 if H > 8 else 8
-        Hp = -(-H // H_blk) * H_blk
-        Ep_local = -(-(E_pad // mp) // 8) * 8
         if (
             self._pallas_eligible(cols.counter)
             and len(cols.kind) // dp <= PF.MAX_ROWS
-            and 2 * Ep_local * Hp * 128 < 2 ** 31
+            and PF.ablk_key_space_fits(E_pad // mp, R)
         ):
             fold_kw = dict(
                 impl="pallas",

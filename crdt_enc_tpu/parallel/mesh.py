@@ -195,6 +195,13 @@ def sharded_fold_cap(member, E_pad: int, dp: int, mp: int) -> int:
     count runs over the actual shard decomposition — dp row blocks are
     contiguous, mp slices are contiguous member ranges."""
     m = np.asarray(member, np.int64)
+    if len(m) % dp:
+        # padding AFTER computing the cap would shift the contiguous dp
+        # block boundaries and silently undercount a shard's tiles
+        raise ValueError(
+            f"pad rows to a dp={dp} multiple BEFORE computing the cap "
+            f"(got {len(m)})"
+        )
     rows_per = max(len(m) // dp, 1)
     E_local = E_pad // mp
     T = max(-(-E_local // 8), 1)

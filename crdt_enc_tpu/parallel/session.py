@@ -54,6 +54,11 @@ BUFFER_BYTES = 4 << 20  # promote out of BUFFER beyond this many column bytes
 HOST_PLANE_CELLS = 1 << 27
 DEVICE_CHUNK_ROWS = 1 << 20  # device-stream row bucket (one compile)
 
+# Tests only: pin the DEVICE_STREAM fold's kernel choice (None = the
+# backend-driven default — the Pallas route engages on real TPU).  With
+# a forced True on a host backend the kernel runs in interpret mode.
+FORCE_PALLAS_STREAM: bool | None = None
+
 
 def _bucket(n: int, floor: int = 8) -> int:
     b = floor
@@ -324,10 +329,29 @@ class OrsetFoldSession:
             self._d_E = E_new
 
     def _device_feed(self, kind, member, actor, counter) -> None:
-        from ..ops.stream import _fold_donated, iter_orset_chunks
+        import jax
+
+        from ..ops import pallas_fold as PF
+        from ..ops.stream import (
+            _fold_donated, _fold_donated_pallas, iter_orset_chunks,
+        )
 
         if len(self.members) > self._d_E:
             self._grow_device_planes()
+        # the flagship Pallas scatter serves the streaming-plane regime
+        # too when eligible — the SAME predicate as the dense/sharded
+        # routes (accel._pallas_eligible) plus the ablk key-space bound
+        # (this route has no wide-layout fallback)
+        use_pallas = bool(
+            len(counter)
+            and self.accel._pallas_eligible(counter)
+            and PF.ablk_key_space_fits(self._d_E, self.R)
+        )
+        interpret = False
+        if FORCE_PALLAS_STREAM is not None:  # tests pin the branch
+            use_pallas = FORCE_PALLAS_STREAM
+            interpret = jax.default_backend() != "tpu"
+        tile_cap = PF.fold_cap(member, self._d_E) if use_pallas else 0
         with trace.span("session.device_fold"):
             rows = min(DEVICE_CHUNK_ROWS, _bucket(len(kind)))
             clock, add, rm = self._d_planes
@@ -336,11 +360,19 @@ class OrsetFoldSession:
                 # batch-local clock would lose its kill-effect on
                 # pre-existing state entries; finish() retires once
                 # against the true merged clock
-                clock, add, rm = _fold_donated(
-                    clock, add, rm, *chunk,
-                    num_members=self._d_E, num_replicas=self.R,
-                    impl="fused", small_counters=False, retire_rm=False,
-                )
+                if use_pallas:
+                    clock, add, rm = _fold_donated_pallas(
+                        clock, add, rm, *chunk,
+                        num_members=self._d_E, num_replicas=self.R,
+                        tile_cap=tile_cap, retire_rm=False,
+                        interpret=interpret,
+                    )
+                else:
+                    clock, add, rm = _fold_donated(
+                        clock, add, rm, *chunk,
+                        num_members=self._d_E, num_replicas=self.R,
+                        impl="fused", small_counters=False, retire_rm=False,
+                    )
             # no block: jax dispatch is async — the next chunk's decrypt
             # and decode overlap the device work
             self._d_planes = (clock, add, rm)

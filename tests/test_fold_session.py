@@ -113,6 +113,21 @@ def test_session_modes_match_host(force_mode, chunk_files):
     )
 
 
+def test_device_stream_pallas_route_matches_host():
+    """The DEVICE_STREAM fold's Pallas route (real-TPU default; interpret
+    mode here) must byte-match the host fold — including the
+    retire_rm=False discipline the session relies on."""
+    import crdt_enc_tpu.parallel.session as S
+
+    host, ops = _history(400, 23, seed=6)
+    S.FORCE_PALLAS_STREAM = True
+    try:
+        folded = _run_session(ops, chunk_files=3, force_mode="device_stream")
+    finally:
+        S.FORCE_PALLAS_STREAM = None
+    assert canonical_bytes(folded) == canonical_bytes(host)
+
+
 @pytest.mark.parametrize("force_mode", ["host_reduce", "device_stream"])
 def test_session_into_existing_state_matches_host(force_mode):
     """Folding a tail into a state that already holds a prefix (the
