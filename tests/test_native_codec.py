@@ -105,6 +105,30 @@ def test_orset_decode_truncated_counter_uint16_member():
     assert decode_orset_payload_spans([payload], actors) is None
 
 
+def test_orset_decode_random_bytes_never_crash():
+    # the decoder (incl. the add fast path) must decline garbage cleanly:
+    # random buffers and randomly truncated valid payloads — never a
+    # crash or wild read (run under the normal allocator; the bound
+    # checks themselves are what this exercises)
+    import numpy as np
+
+    from crdt_enc_tpu.ops.native_decode import decode_orset_payload_spans
+
+    rng = np.random.default_rng(0)
+    actors = [b"a" * 16, b"b" * 16]
+    valid = codec.pack(
+        [[0, 5, [actors[0], 9]], [1, 6, {actors[1]: 2}]] * 10
+    )
+    for trial in range(300):
+        if trial % 2:
+            buf = rng.bytes(int(rng.integers(0, 120)))
+        else:
+            cut = int(rng.integers(0, len(valid)))
+            buf = valid[:cut] + rng.bytes(int(rng.integers(0, 8)))
+        out = decode_orset_payload_spans([buf], actors)
+        assert out is None or len(out) == 6  # decline or decode, no crash
+
+
 def test_counter_decode_matches_python():
     state = PNCounter()
     ops = []
