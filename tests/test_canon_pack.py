@@ -77,6 +77,11 @@ _key = st.one_of(
     st.integers(min_value=0, max_value=2 ** 20),
     st.binary(min_size=1, max_size=16),
     st.text(min_size=1, max_size=8),
+    # composite map keys are real in this codebase ((replica, counter)
+    # dots stay hashable through codec.unpack's use_list=False)
+    st.tuples(
+        st.integers(min_value=0, max_value=255), st.binary(max_size=8)
+    ),
 )
 _value = st.recursive(
     _scalar,
