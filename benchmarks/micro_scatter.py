@@ -297,9 +297,239 @@ def pallas_sections(which):
         log(f"pallas full fold: {t*1e3:.2f} ms  ({N/t/1e6:.0f}M ops/s)")
 
 
+def ablk_sections(which):
+    """Round-4 phase profile of the ablk Pallas fold: where do 7.5ms go?
+
+    Sections:
+      sort1      — the 2-operand bitonic sort comparing ONLY the key
+                   (num_keys=1) vs the production num_keys=2 sort
+      ablkpro    — the full XLA prologue of the ablk path (key calc +
+                   sort + dedup + searchsorted edges + padding)
+      ablkscan   — scatter-phase marginals across kernel-body modes
+                   (hi_mode x win_mode) and sub_rows, isolating the
+                   per-chunk branch overhead and chunk-size sweet spot
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bench import gen_columns
+    from crdt_enc_tpu.ops.pallas_fold import (
+        LANE, TILE_E, fold_cap, orset_scatter_pallas,
+    )
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); N={N} R={R} E={E}")
+    kind, member, actor, counter = gen_columns(N, R, E)
+    rows = [jax.device_put(x, dev) for x in (kind, member, actor, counter)]
+    tile_cap = fold_cap(member, E)
+    log(f"tile_cap={tile_cap}, counter.max()={counter.max()}")
+
+    key_np = (member.astype(np.int64) * R + np.minimum(actor, R - 1)) % (2**31 - 1)
+    key_d = jax.device_put(key_np.astype(np.int32), dev)
+    cnt_d = jax.device_put(counter, dev)
+
+    if "sort1" in which:
+        cnt16_d = jax.device_put(counter.astype(np.int16), dev)
+        for nk, val, tag in (
+            (1, cnt_d, "i32 val"),
+            (2, cnt_d, "i32 val"),
+            (2, cnt16_d, "i16 val"),
+        ):
+            def mk(n, nk=nk, val=val):
+                @jax.jit
+                def run():
+                    def body(carry, _):
+                        k, c = jax.lax.sort((key_d + carry, val), num_keys=nk)
+                        return k[0] % 2, ()
+                    c, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+                    return c
+                return run
+
+            t = marginal(mk)
+            log(f"sort 1M rows, num_keys={nk}, {tag}: {t*1e3:.2f} ms")
+
+    if "ablkpro" in which:
+        # the exact prologue orset_scatter_pallas runs, minus pallas_call
+        from crdt_enc_tpu.ops.pallas_fold import ablk_key_space_fits
+
+        assert ablk_key_space_fits(E, R)
+        Ep = -(-E // TILE_E) * TILE_E
+        T = Ep // TILE_E
+        H = -(-R // LANE)
+        H_BLK = 16 if H > 8 else 8
+        Hp = -(-H // H_BLK) * H_BLK
+        A_BLK = Hp // H_BLK
+        SEG = TILE_E * H_BLK * LANE
+        n_segs = 2 * T * A_BLK
+
+        def mk(n):
+            @jax.jit
+            def run():
+                def body(carry, _):
+                    k, m, a, c = rows
+                    c = c + carry  # carry-anchor
+                    pad = a >= R
+                    a_ix = jnp.minimum(a, R - 1)
+                    is_add = (k == 0) & ~pad
+                    is_rm = (k == 1) & ~pad
+                    tile = m // TILE_E
+                    m_local = m - tile * TILE_E
+                    plane = is_rm.astype(jnp.int32)
+                    a_hi = a_ix // LANE
+                    a_lo = a_ix - a_hi * LANE
+                    blk = a_hi // H_BLK
+                    a_hil = a_hi - blk * H_BLK
+                    seg_id = (tile * 2 + plane) * A_BLK + blk
+                    within = (m_local * H_BLK + a_hil) * LANE + a_lo
+                    sentinel = n_segs * SEG
+                    key = jnp.where(
+                        is_add | is_rm, seg_id * SEG + within, sentinel
+                    )
+                    gval = jnp.where(is_add | is_rm, c, 0)
+                    skey, sval = jax.lax.sort((key, gval), num_keys=2)
+                    nxt = jnp.concatenate(
+                        [skey[1:], jnp.full((1,), -1, skey.dtype)]
+                    )
+                    sval = jnp.where((skey != nxt) & (skey < sentinel), sval, 0)
+                    bounds = jnp.arange(n_segs + 1, dtype=jnp.int32) * SEG
+                    edges = jnp.searchsorted(skey, bounds).astype(jnp.int32)
+                    return edges[0] + sval[0], ()
+                out, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+                return out
+            return run
+
+        t = marginal(mk)
+        log(f"ablk prologue (keys+sort+dedup+edges): {t*1e3:.2f} ms")
+
+    if "ablkscan" in which:
+        default_modes = [
+            ("cond", "cond", 256, "bf16"),    # production default
+            ("fused", "cond", 256, "bf16"),   # no hi-limb branch
+            ("cond", "select", 256, "bf16"),  # no window branch
+            ("fused", "select", 256, "bf16"), # fully branchless body
+            ("fused", "select", 128, "bf16"),
+            ("fused", "select", 512, "bf16"),
+        ]
+        round2_modes = [
+            # round 2 of the profile: SUBK sweep under the round-1
+            # winner (cond hi-limb, branchless window loads).  int8 was
+            # tried and REJECTED: Mosaic cannot legalize the int8 vector
+            # multiply in the one-hot build (arith.muli on vector<...xi8>,
+            # 2026-07-31), so the MXU dtype stays bf16.
+            ("cond", "select", 128, "bf16"),
+            ("cond", "select", 512, "bf16"),
+        ]
+        round3_modes = [
+            # round 3: accumulator layout under the winning config —
+            # blocked = one contiguous 128-row add per chunk + an XLA
+            # transpose, member = 8 strided slice-adds, free reshape
+            ("cond", "select", 256, "bf16", "blocked"),
+            ("cond", "select", 256, "bf16", "member"),
+        ]
+        round4_modes = [
+            # round 4: key-only sort + in-kernel segmented run-max
+            # (dedup_mode="kernel") vs the 2-key sort + XLA dedup, both
+            # under the round-3 winner (blocked accumulator).  Repeated
+            # A/B/A/B in ONE process: single-shot runs swung 4.5-6.1ms
+            # on the same config, so only interleaved deltas count.
+            ("cond", "select", 256, "bf16", "blocked", "kernel"),
+            ("cond", "select", 256, "bf16", "blocked", "sorted"),
+            ("cond", "select", 256, "bf16", "blocked", "kernel"),
+            ("cond", "select", 256, "bf16", "blocked", "sorted"),
+        ]
+        mb_round = os.environ.get("MB_ABLK_ROUND")
+        mode_list = (
+            round4_modes if mb_round == "4"
+            else round3_modes if mb_round == "3"
+            else round2_modes if mb_round == "2"
+            else default_modes
+        )
+        for hi_mode, win_mode, subk, dt, *rest in mode_list:
+            acc = rest[0] if rest else "member"
+            dd = rest[1] if len(rest) > 1 else "sorted"
+
+            def mk(n, hi=hi_mode, win=win_mode, sr=subk, dt=dt, acc=acc,
+                   dd=dd):
+                @jax.jit
+                def run():
+                    def body(carry, _):
+                        k, m, a, c = rows
+                        out = orset_scatter_pallas(
+                            k, m, a, c + carry, num_members=E,
+                            num_replicas=R, tile_cap=tile_cap,
+                            sub_rows=sr, hi_mode=hi, win_mode=win,
+                            dot_impl=dt, acc_mode=acc, dedup_mode=dd,
+                        )
+                        # keep the anchor to {0,1}: counters must stay in
+                        # the production range or the hi-limb branch
+                        # frequency (and exactness) would drift
+                        return out[0][0, 0] % 2, ()
+                    o, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+                    return o
+                return run
+
+            try:
+                t = marginal(mk)
+                log(
+                    f"ablk scatter hi={hi_mode} win={win_mode} "
+                    f"SUBK={subk} dot={dt} acc={acc} dedup={dd}: "
+                    f"{t*1e3:.2f} ms"
+                )
+            except Exception as e:
+                log(
+                    f"ablk scatter hi={hi_mode} win={win_mode} "
+                    f"SUBK={subk} dot={dt} acc={acc} dedup={dd}: FAILED "
+                    f"{type(e).__name__}: {e}"
+                )
+
+
+def lww_sections(which):
+    """Round-4 LWW kernel A/B: window-load cond vs select on the
+    config-4 shape (1M rows, 1M keys)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_enc_tpu.ops.lww import ts_split
+    from crdt_enc_tpu.ops.pallas_lww import lww_fold_pallas, lww_tile_cap
+
+    dev = jax.devices()[0]
+    NK = int(os.environ.get("MB_LWW_KEYS", 1_000_000))
+    RA, V = 10_000, 1 << 15
+    rng = np.random.default_rng(5)
+    key = rng.integers(0, NK, N, dtype=np.int32)
+    hi, lo = ts_split(rng.integers(0, 10 ** 12, N))
+    actor = rng.integers(0, RA, N, dtype=np.int32)
+    value = rng.integers(0, V, N, dtype=np.int32)
+    cap = lww_tile_cap(key, NK)
+    log(f"device: {dev.platform}; LWW N={N} K={NK} tile_cap={cap}")
+    cols = [jax.device_put(x, dev) for x in (key, hi, lo, actor, value)]
+
+    for wm in ("cond", "select"):
+        def mk(n, wm=wm):
+            @jax.jit
+            def run():
+                def body(carry, _):
+                    k, h, l, a, v = cols
+                    out = lww_fold_pallas(
+                        k, h, l, a, v + (carry % 2), num_keys=NK,
+                        num_values=V, tile_cap=cap, win_mode=wm,
+                    )
+                    return out[3][0], ()
+                o, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+                return o
+            return run
+
+        t = marginal(mk)
+        log(f"lww pallas win={wm}: {t*1e3:.2f} ms  ({N/t/1e6:.0f}M rows/s)")
+
+
 if __name__ == "__main__":
     which = set((os.environ.get("MB_WHICH") or "").split(","))
-    if which & {"prologue", "pallasfold"}:
+    if which & {"lwwscan"}:
+        lww_sections(which)
+    elif which & {"sort1", "ablkpro", "ablkscan"}:
+        ablk_sections(which)
+    elif which & {"prologue", "pallasfold"}:
         pallas_sections(which)
     else:
         main()

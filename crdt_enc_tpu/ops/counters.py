@@ -14,6 +14,19 @@ import jax.numpy as jnp
 from ..models.counters import NEG, POS
 
 
+def sum_wide(x: jax.Array) -> jax.Array:
+    """Plane sum in the widest integer the runtime actually has.
+
+    The device-side counter ``value`` is advisory — the authoritative
+    value is derived host-side from the returned planes (numpy int64,
+    see models/counters.py).  Under the default x64-disabled config an
+    ``astype(int64)`` silently truncates to int32 *with a UserWarning
+    per trace*; this helper makes that truncation explicit and silent,
+    and uses real int64 when the caller enabled x64."""
+    wide = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.sum(x.astype(wide))
+
+
 @partial(jax.jit, static_argnames=("num_replicas",))
 def gcounter_fold(
     clock0: jax.Array,  # (R,) int32
@@ -29,7 +42,7 @@ def gcounter_fold(
         jnp.where(pad, 0, counter), jnp.minimum(actor, R - 1), num_segments=R
     )
     clock = jnp.maximum(clock0, jnp.maximum(new, 0))
-    return clock, jnp.sum(clock.astype(jnp.int64))
+    return clock, sum_wide(clock)
 
 
 @partial(jax.jit, static_argnames=("num_replicas",))
@@ -53,7 +66,7 @@ def pncounter_fold(
     )
     p = jnp.maximum(p0, jnp.maximum(p_new, 0))
     n = jnp.maximum(n0, jnp.maximum(n_new, 0))
-    value = jnp.sum(p.astype(jnp.int64)) - jnp.sum(n.astype(jnp.int64))
+    value = sum_wide(p) - sum_wide(n)
     return p, n, value
 
 

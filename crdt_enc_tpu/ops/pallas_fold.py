@@ -283,6 +283,8 @@ def _fold_tile_kernel_ablk(
     klo_ref, khi_ref, vlo_ref, vhi_ref,  # (1, BLK) windows of sorted rows
     out_add_ref, out_rm_ref,  # (1, 8·Hp, 128) int32
     *, Hp: int, H_BLK: int, A_BLK: int, BLK: int, SUBK: int, dot_dtype,
+    hi_mode: str = "cond", win_mode: str = "cond", acc_mode: str = "member",
+    dedup_mode: str = "sorted",
 ):
     t = pl.program_id(0)
     nseg_t = 2 * A_BLK
@@ -302,23 +304,53 @@ def _fold_tile_kernel_ablk(
     acc_t = jnp.int32 if dot_dtype == jnp.int8 else jnp.float32
     dims = (((1,), (1,)), ((), ()))  # contract the SUBK axis of both
 
-    def chunk(j, lo, hi, seg_base):
+    def shift_r(x, d, fill):
+        # along lanes: out[0, i] = x[0, i-d] (fill for i < d)
+        return jnp.concatenate(
+            [jnp.full((1, d), fill, x.dtype), x[:, : SUBK - d]], axis=1
+        )
+
+    def shift_l(x, d, fill):
+        return jnp.concatenate(
+            [x[:, d:], jnp.full((1, d), fill, x.dtype)], axis=1
+        )
+
+    def chunk(j, lo, hi, seg_base, carry):
         """Rows [j·SUBK, (j+1)·SUBK) of the sorted batch, masked to this
         segment's [lo, hi) range: (rows, SUBK) × (SUBK, 128) limb
         matmuls → a (rows, 128) partial.  Keys outside the segment
         decode to a one-hot row outside [0, rows), zeroing their A_T
-        column; the position mask besides zeroes their value."""
+        column; the position mask besides zeroes their value.
+
+        ``dedup_mode="kernel"``: the prologue sorted by KEY ONLY
+        (num_keys=1 — the 2-operand comparator was ~1ms of the sort),
+        so run-max dedup happens here: a segmented Hillis-Steele max
+        scan along lanes (legal because keys are sorted: equal endpoint
+        keys ⇒ the whole span shares the key), seeded by the loop
+        carry (last key, its max-so-far), emitting at run ends the
+        TELESCOPED delta ``run_max − already_emitted`` — the
+        accumulator sums deltas per cell, so the sum still equals the
+        final max, even for runs spanning many chunks."""
         off = pl.multiple_of(j * SUBK, SUBK)
         local = off - w0
         in_hi = local >= BLK
         local = pl.multiple_of(jnp.where(in_hi, local - BLK, local), SUBK)
 
-        def load(ref_lo, ref_hi):
-            return jax.lax.cond(
-                in_hi,
-                lambda: ref_hi[0, pl.ds(local, SUBK)],
-                lambda: ref_lo[0, pl.ds(local, SUBK)],
-            ).reshape(1, SUBK)
+        if win_mode == "select":
+            # branchless: load both windows at the (already-adjusted)
+            # offset and vector-select; the wrong window's load is
+            # in-bounds garbage that the select discards
+            def load(ref_lo, ref_hi):
+                lo_v = ref_lo[0, pl.ds(local, SUBK)]
+                hi_v = ref_hi[0, pl.ds(local, SUBK)]
+                return jnp.where(in_hi, hi_v, lo_v).reshape(1, SUBK)
+        else:
+            def load(ref_lo, ref_hi):
+                return jax.lax.cond(
+                    in_hi,
+                    lambda: ref_hi[0, pl.ds(local, SUBK)],
+                    lambda: ref_lo[0, pl.ds(local, SUBK)],
+                ).reshape(1, SUBK)
 
         k = load(klo_ref, khi_ref)
         v = load(vlo_ref, vhi_ref)
@@ -329,8 +361,57 @@ def _fold_tile_kernel_ablk(
         a_lo = jnp.where(ok, rel & (LANE - 1), -1)
         A_T = (row == row_iota).astype(dot_dtype)  # (rows, SUBK) 0/1
         hot = a_lo == lane_iota  # (128, SUBK)
-        v_ok = jnp.where(ok, v, 0)
+
+        if dedup_mode == "kernel":
+            ck, cm = carry  # (1, 1) int32: last key, its emitted max
+            # masked lanes get unique pseudo-keys (≤ -2) so no run can
+            # cross them; masked lanes are only a prefix (first chunk)
+            # or suffix (last chunk) of the segment's range
+            kk = jnp.where(ok, k, -(pos + 2))
+            m = jnp.where(ok, v, 0)
+            # prefix-of-first-run flag as int32 0/1 — Mosaic cannot
+            # shift/concat i1 mask vectors ("invalid vector register
+            # cast" on the i1 bitcast), so the AND-scan runs as min
+            f = (kk == ck).astype(jnp.int32)
+            d = 1
+            while d < SUBK:
+                kp = shift_r(kk, d, jnp.int32(-1))
+                mp = shift_r(m, d, jnp.int32(0))
+                m = jnp.where(kk == kp, jnp.maximum(m, mp), m)
+                f = jnp.minimum(f, shift_r(f, d, jnp.int32(1)))
+                d *= 2
+            fb = f > 0
+            # seed the carried run's prefix with its max-so-far
+            m = jnp.where(fb, jnp.maximum(m, cm), m)
+            run_end = (kk != shift_l(kk, 1, jnp.int32(-9))) & ok
+            v_ok = jnp.where(run_end, m - jnp.where(fb, cm, 0), 0)
+            carry = (kk[:, SUBK - 1:], m[:, SUBK - 1:])
+        else:
+            v_ok = jnp.where(ok, v, 0)
         B_lo = hot * (v_ok & 127).astype(dot_dtype)
+
+        if hi_mode == "skip":
+            # caller statically guarantees every counter < 128
+            p_lo = jax.lax.dot_general(
+                A_T, B_lo, dims, preferred_element_type=acc_t
+            )
+            return p_lo.astype(jnp.int32), carry
+
+        if hi_mode == "fused":
+            # one MXU call: stack the two limb operands along the output
+            # lanes — no scalar reduce, no branch; ~2× the lo-only FLOPs
+            # but the matmul phase is far from the wall at these shapes
+            B2 = jnp.concatenate(
+                [B_lo, hot * (v_ok >> 7).astype(dot_dtype)], axis=0
+            )  # (2·LANE, SUBK)
+            p2 = jax.lax.dot_general(
+                A_T, B2, dims, preferred_element_type=acc_t
+            )
+            return (
+                (p2[:, LANE:].astype(jnp.int32) << 7)
+                + p2[:, :LANE].astype(jnp.int32)
+            ), carry
+
         p_lo = jax.lax.dot_general(A_T, B_lo, dims, preferred_element_type=acc_t)
 
         def with_hi(_):
@@ -343,10 +424,14 @@ def _fold_tile_kernel_ablk(
         return jax.lax.cond(
             jnp.max(v_ok) >= 128, with_hi,
             lambda _: p_lo.astype(jnp.int32), None,
-        )
+        ), carry
 
     # planes and actor-hi blocks are static → fully unrolled; only the
     # chunk index inside each segment is a dynamic loop
+    carry0 = (
+        jnp.full((1, 1), -1, jnp.int32),  # no real key is negative
+        jnp.zeros((1, 1), jnp.int32),
+    )
     for p, out_ref in ((0, out_add_ref), (1, out_rm_ref)):
         for b in range(A_BLK):
             s = base_seg + p * A_BLK + b
@@ -354,37 +439,46 @@ def _fold_tile_kernel_ablk(
             hi = edges_ref[s + 1]
             seg_base = (t * nseg_t + p * A_BLK + b) * SEG
 
-            def body(j, _, lo=lo, hi=hi, seg_base=seg_base,
+            def body(j, car, lo=lo, hi=hi, seg_base=seg_base,
                      out_ref=out_ref, b=b):
-                part = chunk(j, lo, hi, seg_base)
-                # scatter the (8·H_BLK, 128) partial into the
-                # member-major accumulator as 8 static slice-adds
-                for m in range(TILE_E):
-                    r0 = m * Hp + b * H_BLK
-                    out_ref[0, r0:r0 + H_BLK, :] += (
-                        part[m * H_BLK:(m + 1) * H_BLK, :]
-                    )
-                return 0
+                part, car = chunk(j, lo, hi, seg_base, car)
+                if acc_mode == "blocked":
+                    # one contiguous 128-row add; the accumulator is
+                    # block-major and the caller transposes once in XLA
+                    # (fused into the normalize tail's first read)
+                    r0 = b * (TILE_E * H_BLK)
+                    out_ref[0, r0:r0 + TILE_E * H_BLK, :] += part
+                else:
+                    # scatter the (8·H_BLK, 128) partial into the
+                    # member-major accumulator as 8 static slice-adds
+                    for m in range(TILE_E):
+                        r0 = m * Hp + b * H_BLK
+                        out_ref[0, r0:r0 + H_BLK, :] += (
+                            part[m * H_BLK:(m + 1) * H_BLK, :]
+                        )
+                return car
 
             start_j = lo // SUBK
             end_j = jnp.where(lo == hi, start_j, pl.cdiv(hi, SUBK))
-            jax.lax.fori_loop(start_j, end_j, body, 0)
+            jax.lax.fori_loop(start_j, end_j, body, carry0)
 
 
 @partial(
     jax.jit,
     static_argnames=("num_members", "num_replicas", "tile_cap", "retire_rm",
-                     "dot_impl", "interpret", "sub_rows"),
+                     "dot_impl", "interpret", "sub_rows", "hi_mode",
+                     "win_mode"),
 )
 def _fold_ablk(
     clock0, add0, rm0, kind, member, actor, counter,
     *, num_members, num_replicas, tile_cap, retire_rm, dot_impl, interpret,
-    sub_rows=SUB_ABLK,
+    sub_rows=SUB_ABLK, hi_mode="cond", win_mode="select",
 ):
     add_new, rm_new = orset_scatter_pallas(
         kind, member, actor, counter, num_members=num_members,
         num_replicas=num_replicas, tile_cap=tile_cap, dot_impl=dot_impl,
-        interpret=interpret, sub_rows=sub_rows,
+        interpret=interpret, sub_rows=sub_rows, hi_mode=hi_mode,
+        win_mode=win_mode,
     )
     return _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm)
 
@@ -392,7 +486,8 @@ def _fold_ablk(
 def orset_scatter_pallas(
     kind, member, actor, counter,
     *, num_members, num_replicas, tile_cap, dot_impl="bf16",
-    interpret=False, sub_rows=SUB_ABLK,
+    interpret=False, sub_rows=SUB_ABLK, hi_mode="cond", win_mode="select",
+    acc_mode="member", dedup_mode="sorted",
 ):
     """The ablk layout's scatter phase alone: raw segment-max planes
     ``(add_new, rm_new)`` with no replay gate or normalization.  The
@@ -441,9 +536,14 @@ def orset_scatter_pallas(
     # (a single-operand key·2^14+counter packed sort would halve the
     # comparator's operand traffic, but int64 is unavailable under the
     # default x64-disabled config and the key space overflows int32)
-    skey, sval = jax.lax.sort((key, gval), num_keys=2)
-    nxt = jnp.concatenate([skey[1:], jnp.full((1,), -1, skey.dtype)])
-    sval = jnp.where((skey != nxt) & (skey < sentinel), sval, 0)
+    if dedup_mode == "kernel":
+        # key-only comparator (the 2nd sort key cost ~1ms of the sort);
+        # run-max dedup happens inside the kernel via a segmented scan
+        skey, sval = jax.lax.sort((key, gval), num_keys=1)
+    else:
+        skey, sval = jax.lax.sort((key, gval), num_keys=2)
+        nxt = jnp.concatenate([skey[1:], jnp.full((1,), -1, skey.dtype)])
+        sval = jnp.where((skey != nxt) & (skey < sentinel), sval, 0)
 
     # per-segment [start, end): one searchsorted over segment bounds
     bounds = jnp.arange(n_segs + 1, dtype=jnp.int32) * SEG
@@ -483,7 +583,9 @@ def orset_scatter_pallas(
     )
     out_add, out_rm = pl.pallas_call(
         partial(_fold_tile_kernel_ablk, Hp=Hp, H_BLK=H_BLK, A_BLK=A_BLK,
-                BLK=BLK, SUBK=sub_rows, dot_dtype=dot_dtype),
+                BLK=BLK, SUBK=sub_rows, dot_dtype=dot_dtype,
+                hi_mode=hi_mode, win_mode=win_mode, acc_mode=acc_mode,
+                dedup_mode=dedup_mode),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((T, TILE_E * Hp, LANE), jnp.int32),
@@ -491,6 +593,17 @@ def orset_scatter_pallas(
         ],
         interpret=interpret,
     )(edges, skey, skey, sval, sval)
+
+    if acc_mode == "blocked":
+        # block-major accumulator rows (blk, m_local, a_hil): one XLA
+        # transpose back to member-major — fused into the consumer's
+        # first elementwise read in the common case
+        def decode(o):
+            o = o.reshape(T, A_BLK, TILE_E, H_BLK, LANE)
+            o = o.transpose(0, 2, 1, 3, 4)
+            return o.reshape(Ep, Hp * LANE)[:E, :R]
+
+        return decode(out_add), decode(out_rm)
 
     # accumulator rows are member-major (m_local·Hp + a_hi), so
     # (T, 8·Hp, 128) row-major ≡ (Ep, Hp·128) row-major: free reshape
@@ -529,6 +642,15 @@ def orset_fold_pallas(
     dot_impl: str = "bf16",  # "bf16" (always exact ≤ 2^14); "int8" reserved
     interpret: bool = False,
     layout: str = "ablk",  # "ablk" (round 4, default) | "wide" (round 3)
+    hi_mode: str = "cond",  # "cond" | "fused" | "skip" (ablk only; "skip"
+    #   is legal ONLY when every counter < 128 — caller's static promise)
+    win_mode: str = "select",  # "select" | "cond" (ablk only).  Default
+    #   is the branchless dual-load + vector select: measured 5.08ms vs
+    #   7.68ms scatter phase on the north-star shape (2026-07-31) — the
+    #   per-chunk window cond was a third of the kernel's wall.  A
+    #   "fused" hi_mode measured FASTER than "cond" alone (5.33) but
+    #   REGRESSED combined with select (7.42): Mosaic scheduling, not
+    #   arithmetic — so the data-dependent hi-limb cond stays default.
 ):
     """Drop-in replacement for ``orset_fold`` (same contract, same
     normalized output) with the scatter phase on the MXU.  Handles any
@@ -571,7 +693,7 @@ def orset_fold_pallas(
     args = (clock0, add0, rm0, kind, member, actor, counter)
     if layout == "wide":
         return _fold_wide(*args, **kw)
-    return _fold_ablk(*args, **kw)
+    return _fold_ablk(*args, hi_mode=hi_mode, win_mode=win_mode, **kw)
 
 
 def ablk_key_space_fits(num_members: int, num_replicas: int) -> bool:

@@ -240,11 +240,18 @@ class TpuAccelerator(HostAccelerator):
         )
         if eligible:
             tile_cap = PF.fold_cap(cols.member, E)
+            # all-small counters skip the hi-limb matmul statically —
+            # half the MXU work and no per-chunk max/branch at all
+            hi_mode = (
+                "skip"
+                if int(np.max(cols.counter, initial=0)) < 128 else "cond"
+            )
 
             def fold(c, a, r, kind, member, actor, counter):
                 return PF.orset_fold_pallas(
                     c, a, r, kind, member, actor, counter,
                     num_members=E, num_replicas=R, tile_cap=tile_cap,
+                    hi_mode=hi_mode,
                 )
 
             return fold

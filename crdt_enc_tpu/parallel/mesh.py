@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import ops as K
 from ..ops.columnar import KIND_ADD, KIND_RM
+from ..ops.counters import sum_wide
 
 
 def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
@@ -244,7 +245,7 @@ def pncounter_fold_sharded(mesh: Mesh, p0, n0, sign, actor, counter):
         )
         p = jnp.maximum(p0, jax.lax.pmax(p, "dp"))
         n = jnp.maximum(n0, jax.lax.pmax(n, "dp"))
-        return p, n, jnp.sum(p.astype(jnp.int64)) - jnp.sum(n.astype(jnp.int64))
+        return p, n, sum_wide(p) - sum_wide(n)
 
     fold = jax.shard_map(
         body,

@@ -190,3 +190,98 @@ def test_parity_hypothesis(seed, n, e, r, rm_frac, clocked):
         add0 = np.where(add0 > rm0, add0, 0)
         rm0 = np.where(rm0 > clock0[None, :], rm0, 0)
     _run_both(clock0, add0, rm0, kind, member, actor, counter, e, r)
+
+
+# ---- kernel-body variants (round 4 phase-profile knobs) ------------------
+
+
+@pytest.mark.parametrize("hi_mode", ["fused", "cond"])
+@pytest.mark.parametrize("win_mode", ["select", "cond"])
+def test_parity_kernel_body_modes(hi_mode, win_mode):
+    """The branchless kernel-body variants (hi_mode="fused": one
+    stacked-B matmul instead of the data-dependent hi-limb cond;
+    win_mode="select": dual-load + vector select instead of the window
+    cond) must be byte-identical to the default body on a shape that
+    crosses the 128 limb boundary and straddles windows."""
+    E, R, N = 40, 2500, 3000  # multi actor-block + straddling chunks
+    rng = np.random.default_rng(7)
+    kind = (rng.random(N) < 0.3).astype(np.int8)
+    member = rng.integers(0, E, N, dtype=np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = rng.integers(1, 600, N, dtype=np.int32)  # crosses 128
+    clock0 = rng.integers(0, 80, R).astype(np.int32)
+    z = np.zeros((E, R), np.int32)
+    _run_both(
+        clock0, z, z, kind, member, actor, counter, E, R,
+        layouts=("ablk",), hi_mode=hi_mode, win_mode=win_mode,
+    )
+
+
+def test_parity_hi_skip_small_counters():
+    """hi_mode="skip" (static all-counters-<128 promise) matches the
+    reference when the promise holds."""
+    E, R, N = 32, 300, 2000
+    rng = np.random.default_rng(11)
+    kind = (rng.random(N) < 0.3).astype(np.int8)
+    member = rng.integers(0, E, N, dtype=np.int32)
+    actor = rng.integers(0, R, N, dtype=np.int32)
+    counter = rng.integers(1, 128, N, dtype=np.int32)
+    clock0 = rng.integers(0, 30, R).astype(np.int32)
+    z = np.zeros((E, R), np.int32)
+    _run_both(
+        clock0, z, z, kind, member, actor, counter, E, R,
+        layouts=("ablk",), hi_mode="skip",
+    )
+
+
+def test_parity_blocked_accumulator():
+    """acc_mode="blocked" (one contiguous add per chunk + XLA transpose
+    decode) must match the member-major accumulator on a multi-block
+    shape and on the A_BLK==1 degenerate."""
+    from crdt_enc_tpu.ops.pallas_fold import orset_scatter_pallas
+
+    rng = np.random.default_rng(23)
+    for E, R in ((40, 2600), (16, 200)):
+        N = 3000
+        kind = (rng.random(N) < 0.3).astype(np.int8)
+        member = rng.integers(0, E, N, dtype=np.int32)
+        actor = rng.integers(0, R, N, dtype=np.int32)
+        counter = rng.integers(1, 700, N, dtype=np.int32)
+        cap = fold_cap(member, E)
+        kw = dict(num_members=E, num_replicas=R, tile_cap=cap,
+                  interpret=True)
+        a = orset_scatter_pallas(kind, member, actor, counter, **kw)
+        b = orset_scatter_pallas(kind, member, actor, counter,
+                                 acc_mode="blocked", **kw)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    seed=st.integers(0, 2**16),
+    n=st.integers(1, 600),
+    e=st.integers(1, 24),
+    r=st.integers(1, 120),
+    rm_frac=st.floats(0.0, 1.0),
+)
+def test_parity_kernel_dedup_hypothesis(seed, n, e, r, rm_frac):
+    """dedup_mode="kernel" (key-only sort + in-kernel segmented run-max
+    with telescoping cross-chunk emission) must equal the sorted-dedup
+    scatter everywhere — small (E, R) shapes force key runs that span
+    many SUBK chunks, the hard case for the carry."""
+    from crdt_enc_tpu.ops.pallas_fold import orset_scatter_pallas
+
+    kind, member, actor, counter = _gen(
+        n, e, r, seed, max_counter=min(MAX_COUNTER, 500), rm_frac=rm_frac
+    )
+    cap = fold_cap(member, e)
+    kw = dict(num_members=e, num_replicas=r, tile_cap=cap, interpret=True)
+    a = orset_scatter_pallas(kind, member, actor, counter, **kw)
+    b = orset_scatter_pallas(
+        kind, member, actor, counter, dedup_mode="kernel", **kw
+    )
+    for x, y, nm in zip(a, b, ("add", "rm")):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=nm
+        )

@@ -13,13 +13,13 @@ from crdt_enc_tpu.ops.lww import lww_fold, ts_split
 from crdt_enc_tpu.ops.pallas_lww import lww_fold_pallas, lww_tile_cap
 
 
-def _run_both(key, ts_hi, ts_lo, actor, value, K, V):
+def _run_both(key, ts_hi, ts_lo, actor, value, K, V, win_mode="cond"):
     ref = lww_fold(
         key, ts_hi, ts_lo, actor, value, num_keys=K, num_values=V
     )
     got = lww_fold_pallas(
         key, ts_hi, ts_lo, actor, value, num_keys=K, num_values=V,
-        tile_cap=lww_tile_cap(key, K), interpret=True,
+        tile_cap=lww_tile_cap(key, K), interpret=True, win_mode=win_mode,
     )
     for r, g, name in zip(ref, got, ("hi", "lo", "actor", "value", "present")):
         np.testing.assert_array_equal(
@@ -120,3 +120,11 @@ from hypothesis import given, settings, strategies as st
 )
 def test_parity_hypothesis(seed, n, k, r, v):
     _run_both(*_gen(n, k, r, v, seed), k, v)
+
+
+def test_parity_select_window_mode():
+    """The branchless window-load body (win_mode="select") must be
+    byte-identical to the cond body on a multi-tile shape whose chunks
+    straddle both windows."""
+    _run_both(*_gen(1200, 20000, 30, 50, seed=9), 20000, 50,
+              win_mode="select")
