@@ -318,7 +318,9 @@ def main():
     # the Pallas sorted one-hot-matmul fold (ops/pallas_fold.py): the
     # scatter phase rides the MXU instead of XLA's serialized scatter
     from crdt_enc_tpu.ops.pallas_fold import (
-        MAX_COUNTER, MAX_ROWS, fold_cap, orset_fold_pallas,
+        MAX_COUNTER, MAX_ROWS, fold_cap, fused_defaults,
+        orset_fold_pallas, orset_fold_pallas_fused, orset_pad_state,
+        orset_retire, orset_unpad_state,
     )
 
     interpret = jax.default_backend() != "tpu"
@@ -335,18 +337,68 @@ def main():
                 ),
             )
 
-        # the MXU-native actor-blocked layout is the flagship; the wide
-        # round-3 layout stays as an on-hardware A/B (interpret mode is
-        # too slow to time it twice on CPU)
+        # the MXU-native actor-blocked layout; the wide round-3 layout
+        # stays as an on-hardware A/B (interpret mode is too slow to
+        # time it twice on CPU)
         variant_kws["pallas_bf16"] = pallas_variant("ablk")
         if not interpret:
             variant_kws["pallas_wide"] = pallas_variant("wide")
+
+        # round-5 flagship: normalize tail fused into the kernel
+        # epilogue, deferred rm retirement, host-routed hi-limb skip
+        fd = fused_defaults(E, R, int(counter.max()))
+
+        def fused_single(c, a, r, kind, member, actor, counter):
+            cp, ap, rp = orset_pad_state(
+                c, a, r, num_members=E, num_replicas=R, h_blk=fd["h_blk"])
+            out = orset_fold_pallas_fused(
+                cp, ap, rp, kind, member, actor, counter,
+                num_members=E, num_replicas=R, tile_cap=tile_cap,
+                interpret=interpret, **fd)
+            return orset_unpad_state(*out, num_members=E, num_replicas=R)
+
+        def fused_chained(n_folds):
+            import jax.numpy as jnp
+
+            @jax.jit
+            def run(c, a, r, kind, member, actor, counter):
+                cp, ap, rp = orset_pad_state(
+                    c, a, r, num_members=E, num_replicas=R,
+                    h_blk=fd["h_blk"])
+
+                def body(carry, _):
+                    shift = (carry[0][0] + carry[1][0, 0]) % jnp.int32(
+                        kind.shape[0])
+                    rolled = [
+                        jnp.roll(x, shift)
+                        for x in (kind, member, actor, counter)
+                    ]
+                    # fixed initial planes + carry-derived roll (the
+                    # protocol of `chained` below); deferred retirement
+                    # inside the chain, one finalize after — byte-equal
+                    # to the eager chain (ops/pallas_fold.py proof)
+                    out = orset_fold_pallas_fused(
+                        cp, ap, rp, *rolled,
+                        num_members=E, num_replicas=R, tile_cap=tile_cap,
+                        interpret=interpret, retire_rm=False, **fd)
+                    return out, ()
+                carry, _ = jax.lax.scan(
+                    body, (cp, ap, rp), None, length=n_folds)
+                ck, ad, rmv = carry
+                return orset_unpad_state(
+                    ck, ad, orset_retire(ck, rmv),
+                    num_members=E, num_replicas=R)
+            return run
+
+        variant_kws["pallas_fused"] = dict(
+            _fold=fused_single, _chained=fused_chained)
 
     def fold_call(kw):
         """A (carry, rows...) -> carry fold closure for one variant."""
         fold = kw.get("_fold")
         if fold is not None:
             return fold
+        kw = {k: v for k, v in kw.items() if not k.startswith("_")}
         return lambda c, a, r, kind, member, actor, counter: K.orset_fold(
             c, a, r, kind, member, actor, counter,
             num_members=E, num_replicas=R, **kw,
@@ -488,6 +540,8 @@ def main():
         its own fixpoint sees every add stale, which under-measures any
         variant with value-dependent work (e.g. the Pallas kernel's
         hi-limb skip)."""
+        if "_chained" in kw:  # variant with its own carry layout
+            return kw["_chained"](n_folds)
         fold = fold_call(kw)
 
         @jax.jit

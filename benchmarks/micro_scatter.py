@@ -403,7 +403,9 @@ def ablk_sections(which):
 
     if "ablkscan" in which:
         default_modes = [
-            ("cond", "cond", 256, "bf16"),    # production default
+            ("cond", "cond", 256, "bf16"),    # round-3 default
+            # production default is ("cond", "select") — win_mode
+            # "select" won the round-4 A/B and the wrappers default to it
             ("fused", "cond", 256, "bf16"),   # no hi-limb branch
             ("cond", "select", 256, "bf16"),  # no window branch
             ("fused", "select", 256, "bf16"), # fully branchless body
@@ -437,9 +439,19 @@ def ablk_sections(which):
             ("cond", "select", 256, "bf16", "blocked", "kernel"),
             ("cond", "select", 256, "bf16", "blocked", "sorted"),
         ]
+        round5_modes = [
+            # round 5: dedup A/B under the PRODUCTION accumulator
+            # (member-major) — round 4's A/B ran under blocked.
+            # Interleaved A/B/A/B; only the deltas count.
+            ("cond", "select", 256, "bf16", "member", "kernel"),
+            ("cond", "select", 256, "bf16", "member", "sorted"),
+            ("cond", "select", 256, "bf16", "member", "kernel"),
+            ("cond", "select", 256, "bf16", "member", "sorted"),
+        ]
         mb_round = os.environ.get("MB_ABLK_ROUND")
         mode_list = (
-            round4_modes if mb_round == "4"
+            round5_modes if mb_round == "5"
+            else round4_modes if mb_round == "4"
             else round3_modes if mb_round == "3"
             else round2_modes if mb_round == "2"
             else default_modes
@@ -481,6 +493,121 @@ def ablk_sections(which):
                     f"SUBK={subk} dot={dt} acc={acc} dedup={dd}: FAILED "
                     f"{type(e).__name__}: {e}"
                 )
+
+
+def fused_sections(which):
+    """Round-5: the fused-tail fold (padded-plane carry) vs the unfused
+    full fold, plus the hi_mode=skip/limb_bits=8 ablation."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import gen_columns
+    from crdt_enc_tpu.ops.pallas_fold import (
+        fold_cap, orset_fold_pallas, orset_fold_pallas_fused,
+        orset_pad_state,
+    )
+
+    dev = jax.devices()[0]
+    kind, member, actor, counter = gen_columns(N, R, E)
+    log(f"device: {dev.platform} ({dev.device_kind}); N={N} R={R} E={E} "
+        f"counter.max()={counter.max()}")
+    c0 = jax.device_put(np.zeros(R, np.int32), dev)
+    a0 = jax.device_put(np.zeros((E, R), np.int32), dev)
+    r0 = jax.device_put(np.zeros((E, R), np.int32), dev)
+    rows = [jax.device_put(x, dev) for x in (kind, member, actor, counter)]
+    tile_cap = fold_cap(member, E)
+    skip_ok = counter.max() < 256
+
+    def mk_fused(hi, lb, ret, h_blk=None, subk=None):
+        from crdt_enc_tpu.ops.pallas_fold import SUB_ABLK, orset_retire
+        sr = subk or SUB_ABLK
+
+        def mk(n):
+            @jax.jit
+            def run():
+                cp, ap, rp = orset_pad_state(
+                    c0, a0, r0, num_members=E, num_replicas=R, h_blk=h_blk)
+
+                def body(carry, _):
+                    # fixed initial planes + carry-derived roll: the
+                    # same marginal protocol as the pallasfold section
+                    shift = (carry[0][0] + carry[1][0, 0]) % jnp.int32(N)
+                    k, m, a, c = (jnp.roll(x, shift) for x in rows)
+                    out = orset_fold_pallas_fused(
+                        cp, ap, rp, k, m, a, c,
+                        num_members=E, num_replicas=R, tile_cap=tile_cap,
+                        hi_mode=hi, limb_bits=lb, retire_rm=ret,
+                        h_blk=h_blk, sub_rows=sr,
+                    )
+                    return out, ()
+                carry, _ = jax.lax.scan(body, (cp, ap, rp), None, length=n)
+                if not ret:  # deferred chain: one finalize (cancels in
+                    # the marginal — present in both chain lengths)
+                    carry = (carry[0], carry[1],
+                             orset_retire(carry[0], carry[2]))
+                return carry
+            return run
+        return mk
+
+    def mk_unfused(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                shift = (carry[0][0] + carry[1][0, 0]) % jnp.int32(N)
+                k, m, a, c = (jnp.roll(x, shift) for x in rows)
+                out = orset_fold_pallas(
+                    c0, a0, r0, k, m, a, c,
+                    num_members=E, num_replicas=R, tile_cap=tile_cap,
+                )
+                return out, ()
+            carry, _ = jax.lax.scan(body, (c0, a0, r0), None, length=n)
+            return carry
+        return run
+
+    variants = [("unfused", mk_unfused),
+                ("fused cond/7", mk_fused("cond", 7, True))]
+    if skip_ok:
+        variants += [
+            ("fused skip/8 eager", mk_fused("skip", 8, True)),
+            ("fused skip/8 defer", mk_fused("skip", 8, False)),
+            ("fused skip/8 defer hblk32", mk_fused("skip", 8, False, 32)),
+            ("fused skip/8 defer hblk80", mk_fused("skip", 8, False, 80)),
+            ("fused skip/8 defer hblk32 subk512",
+             mk_fused("skip", 8, False, 32, 512)),
+        ]
+
+    # single-variant measurements swing ±2-3ms between positions in one
+    # process (device/tunnel weather).  Protocol: compile everything
+    # ONCE, then round-robin the timing across variants several times
+    # and keep per-variant minima — only interleaved comparisons count.
+    rounds = int(os.environ.get("MB_FUSED_ROUNDS", 6))
+    fns = {}
+    for name, mk in variants:
+        fns[name] = (mk(1), mk(1 + CHAIN))
+        for f in fns[name]:
+            jax.block_until_ready(f())  # compile now
+        log(f"compiled {name}")
+
+    def time_once(fn):
+        ts = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            force_completion(out)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    best = {name: float("inf") for name, _ in variants}
+    for rd in range(rounds):
+        for name, _ in variants:
+            f1, fk = fns[name]
+            t = (time_once(fk) - time_once(f1)) / CHAIN
+            best[name] = min(best[name], t)
+            log(f"  round {rd} {name}: {t*1e3:.2f} ms")
+    for name, _ in variants:
+        t = best[name]
+        log(f"BEST {name}: {t*1e3:.2f} ms ({N/t/1e6:.0f}M ops/s)")
 
 
 def lww_sections(which):
@@ -525,7 +652,9 @@ def lww_sections(which):
 
 if __name__ == "__main__":
     which = set((os.environ.get("MB_WHICH") or "").split(","))
-    if which & {"lwwscan"}:
+    if which & {"fused"}:
+        fused_sections(which)
+    elif which & {"lwwscan"}:
         lww_sections(which)
     elif which & {"sort1", "ablkpro", "ablkscan"}:
         ablk_sections(which)

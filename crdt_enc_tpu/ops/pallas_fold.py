@@ -283,8 +283,9 @@ def _fold_tile_kernel_ablk(
     klo_ref, khi_ref, vlo_ref, vhi_ref,  # (1, BLK) windows of sorted rows
     out_add_ref, out_rm_ref,  # (1, 8·Hp, 128) int32
     *, Hp: int, H_BLK: int, A_BLK: int, BLK: int, SUBK: int, dot_dtype,
-    hi_mode: str = "cond", win_mode: str = "cond", acc_mode: str = "member",
-    dedup_mode: str = "sorted",
+    hi_mode: str = "cond", win_mode: str = "select",
+    acc_mode: str = "member", dedup_mode: str = "sorted",
+    limb_bits: int = 7,
 ):
     t = pl.program_id(0)
     nseg_t = 2 * A_BLK
@@ -388,10 +389,14 @@ def _fold_tile_kernel_ablk(
             carry = (kk[:, SUBK - 1:], m[:, SUBK - 1:])
         else:
             v_ok = jnp.where(ok, v, 0)
-        B_lo = hot * (v_ok & 127).astype(dot_dtype)
+        # limb split: bf16 carries 8 significant bits, so integer limbs up
+        # to 2^8 are exact — limb_bits=8 halves the skip threshold's
+        # strictness vs the round-3/4 conservative 7
+        lmask = (1 << limb_bits) - 1
+        B_lo = hot * (v_ok & lmask).astype(dot_dtype)
 
         if hi_mode == "skip":
-            # caller statically guarantees every counter < 128
+            # caller statically guarantees every counter < 2^limb_bits
             p_lo = jax.lax.dot_general(
                 A_T, B_lo, dims, preferred_element_type=acc_t
             )
@@ -402,13 +407,13 @@ def _fold_tile_kernel_ablk(
             # lanes — no scalar reduce, no branch; ~2× the lo-only FLOPs
             # but the matmul phase is far from the wall at these shapes
             B2 = jnp.concatenate(
-                [B_lo, hot * (v_ok >> 7).astype(dot_dtype)], axis=0
+                [B_lo, hot * (v_ok >> limb_bits).astype(dot_dtype)], axis=0
             )  # (2·LANE, SUBK)
             p2 = jax.lax.dot_general(
                 A_T, B2, dims, preferred_element_type=acc_t
             )
             return (
-                (p2[:, LANE:].astype(jnp.int32) << 7)
+                (p2[:, LANE:].astype(jnp.int32) << limb_bits)
                 + p2[:, :LANE].astype(jnp.int32)
             ), carry
 
@@ -416,13 +421,13 @@ def _fold_tile_kernel_ablk(
 
         def with_hi(_):
             p_hi = jax.lax.dot_general(
-                A_T, hot * (v_ok >> 7).astype(dot_dtype), dims,
+                A_T, hot * (v_ok >> limb_bits).astype(dot_dtype), dims,
                 preferred_element_type=acc_t,
             )
-            return (p_hi.astype(jnp.int32) << 7) + p_lo.astype(jnp.int32)
+            return (p_hi.astype(jnp.int32) << limb_bits) + p_lo.astype(jnp.int32)
 
         return jax.lax.cond(
-            jnp.max(v_ok) >= 128, with_hi,
+            jnp.max(v_ok) >= (1 << limb_bits), with_hi,
             lambda _: p_lo.astype(jnp.int32), None,
         ), carry
 
@@ -483,37 +488,42 @@ def _fold_ablk(
     return _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm)
 
 
-def orset_scatter_pallas(
-    kind, member, actor, counter,
-    *, num_members, num_replicas, tile_cap, dot_impl="bf16",
-    interpret=False, sub_rows=SUB_ABLK, hi_mode="cond", win_mode="select",
-    acc_mode="member", dedup_mode="sorted",
-):
-    """The ablk layout's scatter phase alone: raw segment-max planes
-    ``(add_new, rm_new)`` with no replay gate or normalization.  The
-    sharded fold (parallel/mesh.py) calls this per device inside
-    shard_map — partials combine across ``dp`` with a ``pmax`` and the
-    normalize tail runs once after — so a mesh compaction runs the same
-    flagship kernel as a single chip.  Traceable (no data-dependent
-    Python); ``tile_cap`` must be the caller's static bound."""
-    E, R = num_members, num_replicas
-    if not ablk_key_space_fits(E, R):
-        # the front door (orset_fold_pallas) reroutes to the wide layout
-        # past this bound; direct callers (the sharded fold) must gate
-        raise ValueError(
-            f"E={E}, R={R} overflows the ablk layout's int32 segment "
-            "keys; route this shape to the XLA fold"
-        )
-    Ep = -(-E // TILE_E) * TILE_E
-    T = Ep // TILE_E
-    H = -(-R // LANE)
-    # actor-hi blocking: H_BLK=16 makes 8·H_BLK = 128 one-hot rows — the
-    # MXU-native matmul height.  Small R degenerates to one block.
-    H_BLK = 16 if H > 8 else 8
-    Hp = -(-H // H_BLK) * H_BLK
-    A_BLK = Hp // H_BLK
-    SEG = TILE_E * H_BLK * LANE
-    n_segs = 2 * T * A_BLK
+class _AblkGeom:
+    """Static geometry of the ablk layout for (E, R) — one place, used
+    by the standalone scatter, the fused-tail fold, and the padded-plane
+    helpers."""
+
+    def __init__(self, E: int, R: int, h_blk: int | None = None):
+        self.E, self.R = E, R
+        self.Ep = -(-E // TILE_E) * TILE_E
+        self.T = self.Ep // TILE_E
+        self.H = -(-R // LANE)
+        # actor-hi blocking: H_BLK=16 makes 8·H_BLK = 128 one-hot rows —
+        # the MXU-native matmul height.  Small R degenerates to one
+        # block.  Larger blocks trade one-hot height (extra VPU compares
+        # + MXU FLOPs, both far from the wall) for fewer segments and
+        # thus fewer boundary chunk visits — the round-5 sweep measured
+        # the visit count, not the FLOPs, as the kernel's cost driver.
+        if h_blk is None:
+            h_blk = 16 if self.H > 8 else 8
+        self.H_BLK = h_blk
+        self.Hp = -(-self.H // self.H_BLK) * self.H_BLK
+        self.A_BLK = self.Hp // self.H_BLK
+        self.SEG = TILE_E * self.H_BLK * LANE
+        self.n_segs = 2 * self.T * self.A_BLK
+        self.Rp = self.Hp * LANE  # padded actor width of the planes
+
+    def fits_int32(self) -> bool:
+        """Whether this geometry's segment keys fit int32."""
+        return 2 * self.Ep * self.Hp * LANE < 2 ** 31
+
+
+def _ablk_prologue(g: _AblkGeom, kind, member, actor, counter,
+                   *, tile_cap, sub_rows, dedup_mode="sorted"):
+    """The XLA front half shared by every ablk path: segment keys, the
+    (key, counter) sort, run-max dedup, per-segment edges, and the
+    window padding.  Returns (edges, skey, sval, BLK, Np)."""
+    R, SEG, n_segs = g.R, g.SEG, g.n_segs
     N = kind.shape[0]
 
     pad = actor >= R
@@ -526,10 +536,10 @@ def orset_scatter_pallas(
     plane = is_rm.astype(jnp.int32)
     a_hi = actor_ix // LANE
     a_lo = actor_ix - a_hi * LANE
-    blk = a_hi // H_BLK
-    a_hil = a_hi - blk * H_BLK
-    seg_id = (tile * 2 + plane) * A_BLK + blk
-    within = (m_local * H_BLK + a_hil) * LANE + a_lo
+    blk = a_hi // g.H_BLK
+    a_hil = a_hi - blk * g.H_BLK
+    seg_id = (tile * 2 + plane) * g.A_BLK + blk
+    within = (m_local * g.H_BLK + a_hil) * LANE + a_lo
     sentinel = n_segs * SEG
     key = jnp.where(is_add | is_rm, seg_id * SEG + within, sentinel)
     gval = jnp.where(is_add | is_rm, counter, 0)
@@ -537,8 +547,9 @@ def orset_scatter_pallas(
     # comparator's operand traffic, but int64 is unavailable under the
     # default x64-disabled config and the key space overflows int32)
     if dedup_mode == "kernel":
-        # key-only comparator (the 2nd sort key cost ~1ms of the sort);
-        # run-max dedup happens inside the kernel via a segmented scan
+        # key-only comparator; run-max dedup happens inside the kernel
+        # via a segmented scan.  Measured 2× SLOWER than the 2-key sort
+        # on hardware (2026-07-31, round-5 A/B) — kept for the record.
         skey, sval = jax.lax.sort((key, gval), num_keys=1)
     else:
         skey, sval = jax.lax.sort((key, gval), num_keys=2)
@@ -555,11 +566,12 @@ def orset_scatter_pallas(
     Np = (-(-N // BLK) + 1) * BLK
     skey = jnp.concatenate([skey, jnp.full((Np - N,), sentinel, jnp.int32)])
     sval = jnp.concatenate([sval, jnp.zeros((Np - N,), jnp.int32)])
-    skey = skey.reshape(1, Np)
-    sval = sval.reshape(1, Np)
+    return edges, skey.reshape(1, Np), sval.reshape(1, Np), BLK, Np
 
-    dot_dtype = jnp.int8 if dot_impl == "int8" else jnp.bfloat16
-    nseg_t = 2 * A_BLK
+
+def _ablk_window_specs(g: _AblkGeom, BLK: int, Np: int):
+    """The four sliding-window BlockSpecs (key lo/hi, val lo/hi)."""
+    nseg_t = 2 * g.A_BLK
     win_lo = pl.BlockSpec(
         (1, BLK), lambda t, e: (0, e[t * nseg_t] // BLK),
         memory_space=pltpu.VMEM,
@@ -570,10 +582,42 @@ def orset_scatter_pallas(
         lambda t, e: (0, jnp.minimum(e[t * nseg_t] // BLK + 1, last_blk)),
         memory_space=pltpu.VMEM,
     )
+    return [win_lo, win_hi, win_lo, win_hi]
+
+
+def orset_scatter_pallas(
+    kind, member, actor, counter,
+    *, num_members, num_replicas, tile_cap, dot_impl="bf16",
+    interpret=False, sub_rows=SUB_ABLK, hi_mode="cond", win_mode="select",
+    acc_mode="member", dedup_mode="sorted", limb_bits=7,
+):
+    """The ablk layout's scatter phase alone: raw segment-max planes
+    ``(add_new, rm_new)`` with no replay gate or normalization.  The
+    sharded fold (parallel/mesh.py) calls this per device inside
+    shard_map — partials combine across ``dp`` with a ``pmax`` and the
+    normalize tail runs once after — so a mesh compaction runs the same
+    flagship kernel as a single chip.  Traceable (no data-dependent
+    Python); ``tile_cap`` must be the caller's static bound."""
+    E, R = num_members, num_replicas
+    if not ablk_key_space_fits(E, R):
+        # the front door (orset_fold_pallas) reroutes to the wide layout
+        # past this bound; direct callers (the sharded fold) must gate
+        raise ValueError(
+            f"E={E}, R={R} overflows the ablk layout's int32 segment "
+            "keys; route this shape to the XLA fold"
+        )
+    g = _AblkGeom(E, R)
+    T, Hp = g.T, g.Hp
+    edges, skey, sval, BLK, Np = _ablk_prologue(
+        g, kind, member, actor, counter,
+        tile_cap=tile_cap, sub_rows=sub_rows, dedup_mode=dedup_mode,
+    )
+
+    dot_dtype = jnp.int8 if dot_impl == "int8" else jnp.bfloat16
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(T,),
-        in_specs=[win_lo, win_hi, win_lo, win_hi],
+        in_specs=_ablk_window_specs(g, BLK, Np),
         out_specs=[
             pl.BlockSpec((1, TILE_E * Hp, LANE), lambda t, e: (t, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -582,10 +626,10 @@ def orset_scatter_pallas(
         ],
     )
     out_add, out_rm = pl.pallas_call(
-        partial(_fold_tile_kernel_ablk, Hp=Hp, H_BLK=H_BLK, A_BLK=A_BLK,
+        partial(_fold_tile_kernel_ablk, Hp=Hp, H_BLK=g.H_BLK, A_BLK=g.A_BLK,
                 BLK=BLK, SUBK=sub_rows, dot_dtype=dot_dtype,
                 hi_mode=hi_mode, win_mode=win_mode, acc_mode=acc_mode,
-                dedup_mode=dedup_mode),
+                dedup_mode=dedup_mode, limb_bits=limb_bits),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((T, TILE_E * Hp, LANE), jnp.int32),
@@ -599,17 +643,210 @@ def orset_scatter_pallas(
         # transpose back to member-major — fused into the consumer's
         # first elementwise read in the common case
         def decode(o):
-            o = o.reshape(T, A_BLK, TILE_E, H_BLK, LANE)
+            o = o.reshape(T, g.A_BLK, TILE_E, g.H_BLK, LANE)
             o = o.transpose(0, 2, 1, 3, 4)
-            return o.reshape(Ep, Hp * LANE)[:E, :R]
+            return o.reshape(g.Ep, Hp * LANE)[:E, :R]
 
         return decode(out_add), decode(out_rm)
 
     # accumulator rows are member-major (m_local·Hp + a_hi), so
     # (T, 8·Hp, 128) row-major ≡ (Ep, Hp·128) row-major: free reshape
-    add_new = out_add.reshape(Ep, Hp * LANE)[:E, :R]
-    rm_new = out_rm.reshape(Ep, Hp * LANE)[:E, :R]
+    add_new = out_add.reshape(g.Ep, Hp * LANE)[:E, :R]
+    rm_new = out_rm.reshape(g.Ep, Hp * LANE)[:E, :R]
     return add_new, rm_new
+
+
+# --------------------------------------------------------------------------
+# fused-tail path (round 5): the normalize tail runs in the kernel epilogue
+#
+# Round-5 phase profile (TPU v5 lite, 2026-07-31): full fold 7.0ms =
+# scatter-incl-prologue 3.4ms + XLA normalize tail ~3.6ms — the tail's
+# elementwise pass over four (E, R) planes was the wall, ~3× its ~1.2ms
+# traffic roofline (XLA materializes the gated intermediate and the
+# axis-0 clock reduce as separate passes).  The fused path applies the
+# replay gate, the clock max-reduce, and the add/rm merge in the kernel
+# epilogue while each tile's accumulator is still in VMEM: the planes
+# are then read once (add0/rm0 input blocks) and written once.  Only rm
+# retirement stays in XLA — it needs the globally-reduced clock.
+#
+# The planes live PADDED in this path — (Ep, Hp·128) with zero padding,
+# clock (Hp·128,) — matching the accumulator layout so the reshape
+# between XLA and kernel stays free; chained folds (compaction sessions,
+# the bench chain) carry padded planes and pad/slice once per session
+# via orset_pad_state / orset_unpad_state.
+# --------------------------------------------------------------------------
+
+
+def _fold_tile_kernel_ablk_fused(
+    edges_ref,  # scalar prefetch: (n_segs+1,) segment row ranges
+    klo_ref, khi_ref, vlo_ref, vhi_ref,  # (1, BLK) windows of sorted rows
+    clock0_ref,  # (Hp, 128) int32 — padded incoming clock
+    add0_ref, rm0_ref,  # (1, 8·Hp, 128) int32 — this tile's prior planes
+    add_out_ref, rm_out_ref,  # (1, 8·Hp, 128) int32 — final add, pre-retire rm
+    clock_out_ref,  # (Hp, 128) int32 — max-accumulated across tiles
+    acc_add, acc_rm,  # VMEM scratch (1, 8·Hp, 128) int32: raw segment maxes
+    *, Hp: int, H_BLK: int, A_BLK: int, BLK: int, SUBK: int, dot_dtype,
+    hi_mode: str, win_mode: str, limb_bits: int,
+):
+    # scatter phase into scratch — the unfused kernel body, verbatim
+    _fold_tile_kernel_ablk(
+        edges_ref, klo_ref, khi_ref, vlo_ref, vhi_ref, acc_add, acc_rm,
+        Hp=Hp, H_BLK=H_BLK, A_BLK=A_BLK, BLK=BLK, SUBK=SUBK,
+        dot_dtype=dot_dtype, hi_mode=hi_mode, win_mode=win_mode,
+        acc_mode="member", dedup_mode="sorted", limb_bits=limb_bits,
+    )
+    # epilogue: _normalize_tail minus rm retirement, per member row-group
+    t = pl.program_id(0)
+    ck = clock0_ref[...]  # (Hp, LANE)
+
+    @pl.when(t == 0)
+    def _init():
+        clock_out_ref[...] = ck
+
+    contrib = None
+    for m in range(TILE_E):
+        r0 = m * Hp
+        a_new = acc_add[0, r0:r0 + Hp, :]
+        gated = jnp.where(a_new > ck, a_new, 0)  # cell-level replay gate
+        contrib = gated if contrib is None else jnp.maximum(contrib, gated)
+        a_m = jnp.maximum(add0_ref[0, r0:r0 + Hp, :], gated)
+        # retire-on-read: identity on well-formed (retired) rm0, and on
+        # a deferred-chain carry it reconstructs exactly the rm the
+        # eager chain would have carried — so chains may skip the
+        # per-fold XLA retire pass and finalize once (orset_retire)
+        r_prev = rm0_ref[0, r0:r0 + Hp, :]
+        r_prev = jnp.where(r_prev > ck, r_prev, 0)
+        r_m = jnp.maximum(r_prev, acc_rm[0, r0:r0 + Hp, :])
+        add_out_ref[0, r0:r0 + Hp, :] = jnp.where(a_m > r_m, a_m, 0)
+        rm_out_ref[0, r0:r0 + Hp, :] = r_m
+    clock_out_ref[...] = jnp.maximum(clock_out_ref[...], contrib)
+
+
+def orset_pad_state(clock0, add0, rm0, *, num_members, num_replicas,
+                    h_blk=None):
+    """Pad ``(clock (R,), add (E,R), rm (E,R))`` to the fused path's
+    carried layout ``(clock (Hp·128,), planes (Ep, Hp·128))`` — zeros in
+    the pad region, which every fused fold preserves."""
+    g = _AblkGeom(num_members, num_replicas, h_blk)
+    cp = jnp.pad(clock0, (0, g.Rp - g.R))
+    ap = jnp.pad(add0, ((0, g.Ep - g.E), (0, g.Rp - g.R)))
+    rp = jnp.pad(rm0, ((0, g.Ep - g.E), (0, g.Rp - g.R)))
+    return cp, ap, rp
+
+
+def orset_unpad_state(clockp, addp, rmp, *, num_members, num_replicas):
+    """Inverse of ``orset_pad_state``."""
+    E, R = num_members, num_replicas
+    return clockp[:R], addp[:E, :R], rmp[:E, :R]
+
+
+def fused_defaults(num_members: int, num_replicas: int,
+                   counter_max: int) -> dict:
+    """Host-side routing for the fused fold's static knobs (round-5
+    sweep, TPU v5 lite): h_blk=32 at large R cuts the segment count —
+    and thus the boundary chunk visits, the measured cost driver — 22%
+    over h_blk=16; limb_bits=8 is exact in bf16 (integers ≤ 2^8), and
+    when the batch's max counter is known < 256 the hi-limb branch is
+    provably dead, so ``hi_mode="skip"`` drops the per-chunk max-reduce
+    + cond entirely (4.70ms vs 6.08ms at h_blk=16 on the north-star
+    shape).  Callers know the batch max (decode layers track it; dense
+    callers take one np.max)."""
+    H = -(-num_replicas // LANE)
+    h_blk = 32 if H > 16 else (16 if H > 8 else 8)
+    # a larger block pads Hp up — fall back if that padding overflows
+    # the int32 key space on a shape the default geometry accepts
+    while h_blk > 8 and not _AblkGeom(
+            num_members, num_replicas, h_blk).fits_int32():
+        h_blk //= 2
+    hi_mode = "skip" if counter_max < 256 else "cond"
+    return dict(h_blk=h_blk, hi_mode=hi_mode, limb_bits=8)
+
+
+def orset_retire(clockp, rmp):
+    """Finalize a deferred chain: the rm retirement the chain's folds
+    skipped (``retire_rm=False``).  One elementwise pass; byte-equal to
+    the eager chain's final rm (proof: retire-on-read in the epilogue
+    reconstructs the eager carry at every step)."""
+    return jnp.where(rmp > clockp[None, :], rmp, 0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_members", "num_replicas", "tile_cap", "retire_rm",
+                     "dot_impl", "interpret", "sub_rows", "hi_mode",
+                     "win_mode", "limb_bits", "h_blk"),
+)
+def orset_fold_pallas_fused(
+    clockp, addp, rmp,  # PADDED state: (Hp·128,), (Ep, Hp·128) ×2
+    kind, member, actor, counter,
+    *, num_members, num_replicas, tile_cap, retire_rm=True,
+    dot_impl="bf16", interpret=False, sub_rows=SUB_ABLK,
+    hi_mode="cond", win_mode="select", limb_bits=7, h_blk=None,
+):
+    """The flagship fold with the normalize tail fused into the kernel
+    epilogue.  Same output as ``orset_fold_pallas`` under
+    ``orset_pad_state``/``orset_unpad_state`` (byte-equality pinned in
+    tests/test_pallas_fold.py).  ``hi_mode="skip"`` is legal only when
+    every counter < 2^limb_bits (host-routed; decode layers know the
+    batch max).  With ``retire_rm=False`` the output rm is DEFERRED
+    (unretired); chain folds that way and finalize with
+    ``orset_retire`` — byte-equal to the eager chain.  ``rm0`` must be
+    retired w.r.t. ``clock0`` or a deferred-chain carry (the epilogue
+    retires it on read).  Reference analogue: the per-op hot loop
+    /root/reference/crdt-enc/src/lib.rs:533-539."""
+    E, R = num_members, num_replicas
+    g = _AblkGeom(E, R, h_blk)
+    if not g.fits_int32():
+        raise ValueError(
+            f"E={E}, R={R} overflows the ablk layout's int32 segment "
+            "keys; route this shape through orset_fold_pallas"
+        )
+    T, Hp = g.T, g.Hp
+    edges, skey, sval, BLK, Np = _ablk_prologue(
+        g, kind, member, actor, counter,
+        tile_cap=tile_cap, sub_rows=sub_rows,
+    )
+
+    clock2d = clockp.reshape(Hp, LANE)
+    add0t = addp.reshape(T, TILE_E * Hp, LANE)  # free: member-major rows
+    rm0t = rmp.reshape(T, TILE_E * Hp, LANE)
+
+    dot_dtype = jnp.int8 if dot_impl == "int8" else jnp.bfloat16
+    plane_in = pl.BlockSpec((1, TILE_E * Hp, LANE), lambda t, e: (t, 0, 0),
+                            memory_space=pltpu.VMEM)
+    clock_spec = pl.BlockSpec((Hp, LANE), lambda t, e: (0, 0),
+                              memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T,),
+        in_specs=_ablk_window_specs(g, BLK, Np)
+        + [clock_spec, plane_in, plane_in],
+        out_specs=[plane_in, plane_in, clock_spec],
+        scratch_shapes=[
+            pltpu.VMEM((1, TILE_E * Hp, LANE), jnp.int32),
+            pltpu.VMEM((1, TILE_E * Hp, LANE), jnp.int32),
+        ],
+    )
+    add_out, rm_pre, clock_out = pl.pallas_call(
+        partial(_fold_tile_kernel_ablk_fused, Hp=Hp, H_BLK=g.H_BLK,
+                A_BLK=g.A_BLK, BLK=BLK, SUBK=sub_rows, dot_dtype=dot_dtype,
+                hi_mode=hi_mode, win_mode=win_mode, limb_bits=limb_bits),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((T, TILE_E * Hp, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((T, TILE_E * Hp, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((Hp, LANE), jnp.int32),
+        ],
+        interpret=interpret,
+    )(edges, skey, skey, sval, sval, clock2d, add0t, rm0t)
+
+    clockp_new = clock_out.reshape(g.Rp)
+    addp_new = add_out.reshape(g.Ep, g.Rp)
+    rmp_new = rm_pre.reshape(g.Ep, g.Rp)
+    if retire_rm:
+        # the one tail step that needs the globally-reduced clock
+        rmp_new = orset_retire(clockp_new, rmp_new)
+    return clockp_new, addp_new, rmp_new
 
 
 def _normalize_tail(clock0, add0, rm0, add_new, rm_new, retire_rm):
@@ -682,6 +919,9 @@ def orset_fold_pallas(
         tile_cap = fold_cap(_np.asarray(member), E)
     # both layouts' key spaces are ~2·Ep·(R padded): guard int32
     if layout == "ablk" and not ablk_key_space_fits(E, R):
+        # NOTE: the wide kernel has no hi_mode/win_mode knobs — a
+        # caller's non-default modes (e.g. a hi_mode="skip" promise) are
+        # intentionally dropped by this reroute, not silently honored
         layout = "wide"  # tighter padding; its own guard below
     Ep = -(-E // TILE_E) * TILE_E
     if (Ep // TILE_E) * (2 * TILE_E * R) + 2 * TILE_E * R >= 2 ** 31:
@@ -700,11 +940,7 @@ def ablk_key_space_fits(num_members: int, num_replicas: int) -> bool:
     """Whether the ablk layout's int32 segment keys can encode (E, R) —
     the ONE predicate every routing site must use (the front door, the
     sharded fold's eligibility gate, the streaming session)."""
-    Ep = -(-num_members // TILE_E) * TILE_E
-    H = -(-num_replicas // LANE)
-    H_blk = 16 if H > 8 else 8
-    Hp = -(-H // H_blk) * H_blk
-    return 2 * Ep * Hp * LANE < 2 ** 31
+    return _AblkGeom(num_members, num_replicas).fits_int32()
 
 
 def fold_cap(member, num_members: int) -> int:

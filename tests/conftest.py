@@ -31,3 +31,20 @@ if "jax" in sys.modules:
         "a backend initialized before conftest could force cpu; "
         "tests would touch the TPU tunnel"
     )
+
+
+# ---- collection bookkeeping for the PARITY.md test-count assertion ----
+# (tests/test_parity_count.py): the documented count kept drifting from
+# the real one (VERDICT r4 weak item 5), so it is now asserted in CI.
+COLLECT_INFO = {"n_items": None, "n_files": None, "n_deselected": 0}
+
+
+def pytest_deselected(items):
+    # -k / -m / --deselect runs must not trip the count assertion
+    COLLECT_INFO["n_deselected"] += len(items)
+
+
+def pytest_collection_finish(session):
+    files = {item.location[0] for item in session.items}
+    COLLECT_INFO["n_items"] = len(session.items)
+    COLLECT_INFO["n_files"] = len(files)

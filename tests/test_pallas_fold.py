@@ -285,3 +285,98 @@ def test_parity_kernel_dedup_hypothesis(seed, n, e, r, rm_frac):
         np.testing.assert_array_equal(
             np.asarray(x), np.asarray(y), err_msg=nm
         )
+
+
+# ---- round 5: the fused-tail fold (normalize tail in the kernel epilogue)
+
+
+def _well_formed_state(E, R, seed):
+    """A state every real fold output satisfies: add>rm-or-0, rm retired."""
+    rng = np.random.default_rng(seed)
+    clock0 = rng.integers(0, 50, R).astype(np.int32)
+    add0 = np.zeros((E, R), np.int32)
+    rm0 = np.zeros((E, R), np.int32)
+    add0[rng.random((E, R)) < 0.1] = 40
+    rm0[rng.random((E, R)) < 0.05] = 60
+    add0 = np.where(add0 > rm0, add0, 0)
+    rm0 = np.where(rm0 > clock0[None, :], rm0, 0)
+    return clock0, add0, rm0
+
+
+@pytest.mark.parametrize("h_blk", [None, 32, 80])
+@pytest.mark.parametrize("E,R,N", [(16, 300, 4000), (8, 2100, 3000),
+                                   (40, 130, 2500)])
+def test_fused_chain_parity(E, R, N, h_blk):
+    """Two chained fused folds (eager AND deferred+finalize) must match
+    the unfused chain byte-for-byte, across h_blk geometries."""
+    from crdt_enc_tpu.ops.pallas_fold import (
+        orset_fold_pallas_fused, orset_pad_state, orset_retire,
+        orset_unpad_state,
+    )
+
+    st = _well_formed_state(E, R, 7)
+    b1 = _gen(N, E, R, 1, max_counter=250)
+    b2 = _gen(N, E, R, 2, max_counter=250)
+    cap = 1 << 13
+    e1 = orset_fold_pallas(*st, *b1, num_members=E, num_replicas=R,
+                           tile_cap=cap, interpret=True)
+    e2 = orset_fold_pallas(*e1, *b2, num_members=E, num_replicas=R,
+                           tile_cap=cap, interpret=True)
+    p = orset_pad_state(*st, num_members=E, num_replicas=R, h_blk=h_blk)
+    # eager fused chain
+    f1 = orset_fold_pallas_fused(*p, *b1, num_members=E, num_replicas=R,
+                                 tile_cap=cap, interpret=True, h_blk=h_blk)
+    f2 = orset_fold_pallas_fused(*f1, *b2, num_members=E, num_replicas=R,
+                                 tile_cap=cap, interpret=True, h_blk=h_blk)
+    got = orset_unpad_state(*f2, num_members=E, num_replicas=R)
+    for r, g, name in zip(e2, got, ("clock", "add", "rm")):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=f"eager:{name}")
+    # deferred chain under the skip/8 route + one finalize
+    d1 = orset_fold_pallas_fused(*p, *b1, num_members=E, num_replicas=R,
+                                 tile_cap=cap, interpret=True, h_blk=h_blk,
+                                 retire_rm=False, hi_mode="skip",
+                                 limb_bits=8)
+    d2 = orset_fold_pallas_fused(*d1, *b2, num_members=E, num_replicas=R,
+                                 tile_cap=cap, interpret=True, h_blk=h_blk,
+                                 retire_rm=False, hi_mode="skip",
+                                 limb_bits=8)
+    dc, da, dr = d2
+    got = orset_unpad_state(dc, da, orset_retire(dc, dr),
+                            num_members=E, num_replicas=R)
+    for r, g, name in zip(e2, got, ("clock", "add", "rm")):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=f"deferred:{name}")
+
+
+def test_fused_big_counters_cond_limb8():
+    """Counters ≥ 256 must stay exact through the 8-bit limb split with
+    the data-dependent hi-limb cond."""
+    from crdt_enc_tpu.ops.pallas_fold import (
+        orset_fold_pallas_fused, orset_pad_state, orset_unpad_state,
+    )
+
+    E, R, N = 16, 300, 4000
+    st = _well_formed_state(E, R, 11)
+    b = _gen(N, E, R, 3, max_counter=MAX_COUNTER)
+    cap = 1 << 13
+    ref = orset_fold_pallas(*st, *b, num_members=E, num_replicas=R,
+                            tile_cap=cap, interpret=True)
+    p = orset_pad_state(*st, num_members=E, num_replicas=R)
+    out = orset_fold_pallas_fused(*p, *b, num_members=E, num_replicas=R,
+                                  tile_cap=cap, interpret=True,
+                                  hi_mode="cond", limb_bits=8)
+    got = orset_unpad_state(*out, num_members=E, num_replicas=R)
+    for r, g, name in zip(ref, got, ("clock", "add", "rm")):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=name)
+
+
+def test_fused_defaults_routing():
+    from crdt_enc_tpu.ops.pallas_fold import fused_defaults
+
+    d = fused_defaults(4096, 10_000, 132)
+    assert d == dict(h_blk=32, hi_mode="skip", limb_bits=8)
+    d = fused_defaults(4096, 10_000, 300)
+    assert d["hi_mode"] == "cond" and d["limb_bits"] == 8
+    assert fused_defaults(64, 1000, 10)["h_blk"] == 8  # H=8 -> single block

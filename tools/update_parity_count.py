@@ -1,0 +1,47 @@
+"""Regenerate the test count in docs/PARITY.md row 12 from a live
+``pytest --collect-only`` (the count is asserted by
+tests/test_parity_count.py on every full suite run)."""
+
+import pathlib
+import re
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PARITY = ROOT / "docs" / "PARITY.md"
+COUNT_RE = re.compile(r"(`tests/` — )(\d+)( tests)")
+
+
+def collected_count() -> int:
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "--collect-only", "-q"],
+        cwd=ROOT, capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        raise SystemExit(
+            f"collection failed (rc={r.returncode}) — refusing to write "
+            f"a partial count:\n{r.stdout[-2000:]}"
+        )
+    m = re.search(r"(\d+) tests collected", r.stdout)
+    if not m:
+        raise SystemExit(f"could not parse collection output:\n{r.stdout[-2000:]}")
+    if re.search(r"\berrors?\b", r.stdout.splitlines()[-1] if r.stdout else ""):
+        raise SystemExit(
+            f"collection reported errors — refusing to write a partial "
+            f"count:\n{r.stdout[-2000:]}"
+        )
+    return int(m.group(1))
+
+
+def main():
+    n = collected_count()
+    text = PARITY.read_text()
+    new, subs = COUNT_RE.subn(rf"\g<1>{n}\g<3>", text)
+    if not subs:
+        raise SystemExit("PARITY.md row 12 lost its test-count marker")
+    PARITY.write_text(new)
+    print(f"docs/PARITY.md test count -> {n}")
+
+
+if __name__ == "__main__":
+    main()
