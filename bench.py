@@ -204,6 +204,46 @@ def host_stats(times: list) -> dict:
     )
 
 
+# Canonical pinned host baselines (VERDICT r4 weak items 1/6): same-run
+# host rates swing 1.5× with machine weather even under the median-of-5
+# protocol, so published ratios use ONE committed idle-box measurement
+# per config (benchmarks/pinned_baselines.json, written by
+# benchmarks/pin_baselines.py with raw samples).  Same-run rates are
+# still recorded for drift detection.
+PINNED_PATH = os.path.join(REPO_ROOT, "benchmarks", "pinned_baselines.json")
+
+
+def load_pinned(config: str, shape: dict):
+    """The pinned host record for ``config``, or None when absent or
+    measured at a different workload shape (ratios across shapes would
+    be meaningless — e.g. smoke runs)."""
+    try:
+        with open(PINNED_PATH) as f:
+            pins = json.load(f)
+    except (OSError, ValueError):
+        return None
+    rec = pins.get(config)
+    if not rec or rec.get("shape") != shape:
+        return None
+    return rec
+
+
+def pinned_ratio_fields(config: str, shape: dict, device_rate: float,
+                        same_run_ratio: float) -> dict:
+    """vs_baseline resolution: the pinned ratio when a matching pin
+    exists (the stable denominator of record), same-run otherwise —
+    with both always recorded explicitly."""
+    rec = load_pinned(config, shape)
+    out = {"vs_same_run_host": round(same_run_ratio, 2)}
+    if rec:
+        out["vs_pinned_baseline"] = round(device_rate / rec["host_rate"], 2)
+        out["pinned_host_rate"] = rec["host_rate"]
+        out["vs_baseline"] = out["vs_pinned_baseline"]
+    else:
+        out["vs_baseline"] = round(same_run_ratio, 2)
+    return out
+
+
 # Measured spread of tunnel round-trip jitter on this host (single source of
 # truth — benchmarks/suite.py imports it): a marginal per-fold time below
 # TUNNEL_JITTER_S / chain is noise, not device time.
@@ -627,11 +667,16 @@ def main():
     pct_hbm = roofline_pct(bytes_model, t_tpu, on_tpu)
     log(f"roofline: ≥{bytes_model/1e6:.0f}MB/fold → {pct_hbm}% of HBM peak")
 
+    # same key + workload as suite config 3 — one pin serves both
+    ratio_fields = pinned_ratio_fields(
+        "orset_10kx1M", {"N": N, "R": R, "E": E, "n_host": N_HOST},
+        tpu_rate, tpu_rate / host_rate,
+    )
     result = {
         "metric": "orset_compaction_fold_ops_per_sec",
         "value": round(tpu_rate, 1),
         "unit": "ops/s",
-        "vs_baseline": round(tpu_rate / host_rate, 2),
+        **ratio_fields,
         # which timing method produced `value` — consumers must not compare
         # a latency-bound fallback number against a marginal-chain number
         "method": method,

@@ -54,7 +54,17 @@ def running_count(group: np.ndarray, n_groups: int) -> np.ndarray:
 # Pinned host-baseline protocol — the single implementation lives in
 # bench.py (median-of-BENCH_HOST_RUNS with raw samples recorded); every
 # config here measures through it so the two harnesses cannot drift.
-from bench import host_median, host_stats  # noqa: E402
+from bench import host_median, host_stats, load_pinned  # noqa: E402
+
+
+def _host_only_record(config, n_ops, shape, t_host, host_times):
+    """What the pinning tool (pin_baselines.py) needs: the config's host
+    rate under the exact workload the suite runs, with raw samples."""
+    return dict(
+        config=config, host_only=True, n_ops=n_ops, shape=shape,
+        host_rate=n_ops / t_host, median_s=t_host,
+        **host_stats(host_times),
+    )
 
 
 def timeit(fn, iters: int) -> float:
@@ -121,7 +131,8 @@ def actor_bytes_table(R: int) -> list:
 # --------------------------------------------------------------- config 1+2
 
 
-def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
+def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1,
+                   host_only: bool = False) -> dict:
     """Config 1: G-Counter, 4 replicas, 1k increment ops."""
     import jax
     import jax.numpy as jnp
@@ -143,6 +154,9 @@ def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
         return time.perf_counter() - t0, state
 
     t_host, host_times, state = host_median(host_once)
+    if host_only:
+        return _host_only_record(
+            "gcounter_4x1k", N, dict(N=N, R=R), t_host, host_times)
 
     clock0 = np.zeros(R, np.int32)
     dev_args = [jax.device_put(x) for x in (clock0, actor, counter)]
@@ -169,12 +183,14 @@ def bench_gcounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     equal = dev_clock == state.clock.counters and int(total) == state.read()
     return dict(
         config="gcounter_4x1k", metric="ops_folded_per_sec", N=N, R=R,
+        _pin_shape=dict(N=N, R=R),
         host_rate=N / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
         timing=timing, bytes_model=8 * N + 2 * 4 * R, **host_stats(host_times),
     )
 
 
-def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
+def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1,
+                    host_only: bool = False) -> dict:
     """Config 2: PN-Counter, 1k replicas, 100k mixed inc/dec ops."""
     import jax
     import jax.numpy as jnp
@@ -203,6 +219,10 @@ def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
         return time.perf_counter() - t0, state
 
     t_host, host_times, state = host_median(host_once)
+    if host_only:
+        return _host_only_record(
+            "pncounter_1kx100k", n_host, dict(N=N, R=R, n_host=n_host),
+            t_host, host_times)
 
     p0 = np.zeros(R, np.int32)
     n0 = np.zeros(R, np.int32)
@@ -236,6 +256,7 @@ def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
     )
     return dict(
         config="pncounter_1kx100k", metric="ops_folded_per_sec", N=N, R=R,
+        _pin_shape=dict(N=N, R=R, n_host=n_host),
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
         timing=timing, bytes_model=9 * N + 4 * 4 * R,
         **host_stats(host_times),
@@ -248,7 +269,8 @@ def bench_pncounter(N: int, R: int, iters: int, cmul: int = 1) -> dict:
 from bench import orset_fold_bytes_model as _orset_bytes_model
 
 
-def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) -> dict:
+def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1,
+                host_only: bool = False) -> dict:
     """Config 3 (north star): OR-Set, 10k replicas, 1M add/remove ops."""
     import jax
 
@@ -259,6 +281,17 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
     from crdt_enc_tpu.utils import codec
 
     kind, member, actor, counter = north.gen_columns(N, R, E)
+    if host_only:
+        def host_once():
+            state, t = north.host_fold(
+                kind[:n_host], member[:n_host], actor[:n_host],
+                counter[:n_host], R)
+            return t, state
+
+        t_host, host_times, _ = host_median(host_once)
+        return _host_only_record(
+            "orset_10kx1M", n_host, dict(N=N, R=R, E=E, n_host=n_host),
+            t_host, host_times)
 
     # the Pallas sorted one-hot-matmul fold when eligible (the north-star
     # winner, see bench.py), else the fused XLA scatter
@@ -332,6 +365,7 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
     t_dev, timing = timeit_marginal(make_chained, iters, chain=20 * cmul)
     return dict(
         config="orset_10kx1M", metric="ops_folded_per_sec", N=N, R=R, E=E,
+        _pin_shape=dict(N=N, R=R, E=E, n_host=n_host),
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
         timing=timing, bytes_model=_orset_bytes_model(N, E, R),
         **host_stats(host_times),
@@ -341,7 +375,8 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1) 
 # ----------------------------------------------------------------- config 4
 
 
-def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int = 1) -> dict:
+def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int,
+                 cmul: int = 1, host_only: bool = False) -> dict:
     """Config 4: LWW-map, 1M keys, 10k replicas, timestamped writes."""
     import jax
     import jax.numpy as jnp
@@ -371,6 +406,10 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int, cmul: int
         return time.perf_counter() - t0, state
 
     t_host, host_times, state = host_median(host_once)
+    if host_only:
+        return _host_only_record(
+            "lwwmap_1Mx10k", n_host,
+            dict(N=N, K=K_keys, R=R, n_host=n_host), t_host, host_times)
 
     args = [jax.device_put(x) for x in (key, hi, lo, actor, value)]
     # value domain is 0..99 rank-interned, so the (actor, value) cascades
@@ -537,7 +576,8 @@ def _build_encrypted_files(N, R, E, ops_per_file, key, n_headers):
     return payloads, plain_payloads, headers, actors
 
 
-def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
+def bench_streaming(N, R, E, ops_per_file, n_host_files, iters,
+                    host_only: bool = False) -> dict:
     """Config 5: mixed header-CRDT + OR-Set, 100k replicas, streaming
     compaction with the XChaCha20-Poly1305 decrypt front end."""
     import secrets
@@ -575,6 +615,11 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
 
     t_host, host_times, state = host_median(host_once)
     host_rate = n_ops / t_host
+    if host_only:
+        return _host_only_record(
+            "mixed_streaming_100k", n_ops,
+            dict(R=R, E=E, ops_per_file=ops_per_file,
+                 n_host_files=n_host_files), t_host, host_times)
 
     # ---- streaming pipeline: chunked threaded batch decrypt overlapping
     # the native columnar decode (fold_payload_stream), then one sparse
@@ -617,6 +662,8 @@ def bench_streaming(N, R, E, ops_per_file, n_host_files, iters) -> dict:
     equal = bool(ok) and codec.pack(sub.to_obj()) == codec.pack(state.to_obj())
     return dict(
         config="mixed_streaming_100k", metric="ops_streamed_per_sec",
+        _pin_shape=dict(R=R, E=E, ops_per_file=ops_per_file,
+                        n_host_files=n_host_files),
         N=total_ops, R=R, E=E, files=n_files,
         host_rate=host_rate, device_rate=dev_rate, byte_equal=bool(equal),
         **host_stats(host_times),
@@ -691,14 +738,21 @@ def main():
         )
         r["pct_hbm_peak"] = pct
         r["super_roofline"] = bool(pct is not None and pct > 100.0)
+        from bench import pinned_ratio_fields
+
+        r.update(pinned_ratio_fields(
+            r["config"], r.pop("_pin_shape", None) or {},
+            r["device_rate"], r["device_rate"] / r["host_rate"],
+        ))
         if r["super_roofline"]:
             log(
                 f"WARNING: config {c} marginal implies {pct:.0f}% of HBM "
                 "peak — impossible (hoisted chain); excluded from geomean"
             )
         else:
-            ratios.append(r["device_rate"] / r["host_rate"])  # unrounded
-        r["vs_baseline"] = round(r["device_rate"] / r["host_rate"], 2)
+            # the geomean of record uses the pinned denominator when
+            # available (VERDICT r4: same-run host rates swing 1.5×)
+            ratios.append(r["vs_baseline"])
         r["host_rate"] = round(r["host_rate"], 1)
         r["device_rate"] = round(r["device_rate"], 1)
         results.append(r)
