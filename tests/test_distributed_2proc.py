@@ -29,7 +29,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_fold():
+def _run_workers(extra_args=(), timeout=300):
     port = _free_port()
     env = os.environ.copy()
     # a wedged TPU tunnel must not hang the workers at interpreter start
@@ -40,7 +40,7 @@ def test_two_process_fold():
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(rank), str(port)],
+            [sys.executable, _WORKER, str(rank), str(port), *extra_args],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
@@ -51,7 +51,7 @@ def test_two_process_fold():
     outs = []
     try:
         for p in procs:
-            outs.append(p.communicate(timeout=300))
+            outs.append(p.communicate(timeout=timeout))
     finally:
         for p in procs:
             p.kill()
@@ -61,3 +61,14 @@ def test_two_process_fold():
             f"stderr:\n{err}"
         )
         assert f"DIST_OK rank={rank}" in out, (rank, out, err)
+
+
+def test_two_process_fold():
+    _run_workers()
+
+
+def test_two_process_core_lifecycle(tmp_path):
+    """VERDICT r4 item 6: the full Core lifecycle — write, mesh-ingest,
+    convergence checks, CONCURRENT compaction, post-compact read — across
+    2 real jax.distributed processes sharing one fs remote."""
+    _run_workers(["lifecycle", str(tmp_path)], timeout=600)
