@@ -27,6 +27,31 @@ def sum_wide(x: jax.Array) -> jax.Array:
     return jnp.sum(x.astype(wide))
 
 
+# Below this many rows the one serialized scatter-max is cheaper than a
+# bitonic sort pass (measured round 5: the sort path wins ~3-4× at the
+# config-2 shape 100k×1k; tiny batches like config 1's 1k×4 are
+# dispatch-bound either way, where the scatter's lower op count wins).
+SORTED_MIN_ROWS = 1 << 13
+
+
+def _sorted_segment_max(key, val, n_segments: int):
+    """Segment max with NO scatter: sort (key, value) pairs — each
+    segment's maximum lands at its run end — then one searchsorted over
+    the segment bounds and an (n_segments,)-element gather read every
+    result.  TPUs have no fast random scatter (``jax.ops.segment_max``
+    lowers to a ~9ns/row serialized loop; the round-5 profile put the
+    config-2 fold at 1.75ms for 0.9MB of traffic), but their bitonic
+    sort is fast and the run-end gather is tiny.  Same move as the
+    flagship ORSet fold's sort phase (ops/pallas_fold.py), shrunk to the
+    1-D counter planes.  Keys ≥ n_segments act as padding sentinels."""
+    skey, sval = jax.lax.sort((key, val), num_keys=2)
+    dt = key.dtype
+    edges = jnp.searchsorted(skey, jnp.arange(n_segments + 1, dtype=dt))
+    start, stop = edges[:-1], edges[1:]
+    last = jnp.maximum(stop - 1, 0)
+    return jnp.where(stop > start, sval[last], 0)
+
+
 @partial(jax.jit, static_argnames=("num_replicas",))
 def gcounter_fold(
     clock0: jax.Array,  # (R,) int32
@@ -38,9 +63,14 @@ def gcounter_fold(
     """Fold increment dots into the per-replica clock; value = sum(clock)."""
     R = num_replicas
     pad = actor >= R
-    new = jax.ops.segment_max(
-        jnp.where(pad, 0, counter), jnp.minimum(actor, R - 1), num_segments=R
-    )
+    if actor.shape[0] >= SORTED_MIN_ROWS:
+        key = jnp.where(pad, R, actor)
+        new = _sorted_segment_max(key, jnp.where(pad, 0, counter), R)
+    else:
+        new = jax.ops.segment_max(
+            jnp.where(pad, 0, counter), jnp.minimum(actor, R - 1),
+            num_segments=R,
+        )
     clock = jnp.maximum(clock0, jnp.maximum(new, 0))
     return clock, sum_wide(clock)
 
@@ -58,12 +88,25 @@ def pncounter_fold(
     R = num_replicas
     pad = actor >= R
     actor_ix = jnp.minimum(actor, R - 1)
-    p_new = jax.ops.segment_max(
-        jnp.where(~pad & (sign == POS), counter, 0), actor_ix, num_segments=R
-    )
-    n_new = jax.ops.segment_max(
-        jnp.where(~pad & (sign == NEG), counter, 0), actor_ix, num_segments=R
-    )
+    if actor.shape[0] >= SORTED_MIN_ROWS:
+        # ONE sort serves both planes: key interleaves (actor, plane),
+        # pads sort to the 2R sentinel; deinterleave by reshape
+        key = jnp.where(
+            pad, 2 * R, actor_ix * 2 + (sign == NEG).astype(jnp.int32)
+        )
+        both = _sorted_segment_max(
+            key, jnp.where(pad, 0, counter), 2 * R
+        ).reshape(R, 2)
+        p_new, n_new = both[:, 0], both[:, 1]
+    else:
+        p_new = jax.ops.segment_max(
+            jnp.where(~pad & (sign == POS), counter, 0), actor_ix,
+            num_segments=R,
+        )
+        n_new = jax.ops.segment_max(
+            jnp.where(~pad & (sign == NEG), counter, 0), actor_ix,
+            num_segments=R,
+        )
     p = jnp.maximum(p0, jnp.maximum(p_new, 0))
     n = jnp.maximum(n0, jnp.maximum(n_new, 0))
     value = sum_wide(p) - sum_wide(n)
