@@ -23,6 +23,13 @@ import jax.numpy as jnp
 
 from bench import gen_columns, force_completion
 
+try:  # persistent compile cache: repeat profile runs skip the 30-60s jits
+    import crdt_enc_tpu
+
+    crdt_enc_tpu.enable_compilation_cache()
+except Exception:
+    pass
+
 N = int(os.environ.get("MB_OPS", 1_000_000))
 R = int(os.environ.get("MB_REPLICAS", 10_000))
 E = int(os.environ.get("MB_MEMBERS", 4096))
@@ -631,8 +638,13 @@ def lww_sections(which):
     log(f"device: {dev.platform}; LWW N={N} K={NK} tile_cap={cap}")
     cols = [jax.device_put(x, dev) for x in (key, hi, lo, actor, value)]
 
-    for wm in ("cond", "select"):
-        def mk(n, wm=wm):
+    from crdt_enc_tpu.ops.pallas_lww import lww_limbs
+
+    lb = lww_limbs(hi, lo, actor, V)
+    log(f"static limbs: {lb}")
+
+    def mk_fold(wm, limbs):
+        def mk(n):
             @jax.jit
             def run():
                 def body(carry, _):
@@ -640,14 +652,62 @@ def lww_sections(which):
                     out = lww_fold_pallas(
                         k, h, l, a, v + (carry % 2), num_keys=NK,
                         num_values=V, tile_cap=cap, win_mode=wm,
+                        limbs=limbs,
                     )
                     return out[3][0], ()
                 o, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
                 return o
             return run
+        return mk
 
-        t = marginal(mk)
-        log(f"lww pallas win={wm}: {t*1e3:.2f} ms  ({N/t/1e6:.0f}M rows/s)")
+    # the 4-operand sort alone (the kernel's XLA prologue wall candidate)
+    def mk_sort(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                k, h, l, a, v = cols
+                av = a * V + (v + carry % 2)
+                sk, sh, sl, sav = jax.lax.sort((k, h, l, av), num_keys=4)
+                return sav[0] % 2, ()
+            o, _ = jax.lax.scan(body, jnp.int32(0), None, length=n)
+            return o
+        return run
+
+    variants = [
+        ("sort4 only", mk_sort),
+        ("lww cond dyn-limb", mk_fold("cond", None)),
+        ("lww select dyn-limb", mk_fold("select", None)),
+        ("lww cond static-limb", mk_fold("cond", lb)),
+        ("lww select static-limb", mk_fold("select", lb)),
+    ]
+    rounds = int(os.environ.get("MB_FUSED_ROUNDS", 4))
+    fns = {}
+    for name, mk in variants:
+        fns[name] = (mk(1), mk(1 + CHAIN))
+        for f in fns[name]:
+            jax.block_until_ready(f())
+        log(f"compiled {name}")
+
+    def time_once(fn):
+        ts = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            force_completion(out)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    best = {name: float("inf") for name, _ in variants}
+    for rd in range(rounds):
+        for name, _ in variants:
+            f1, fk = fns[name]
+            t = (time_once(fk) - time_once(f1)) / CHAIN
+            best[name] = min(best[name], t)
+            log(f"  round {rd} {name}: {t*1e3:.2f} ms")
+    for name, _ in variants:
+        t = best[name]
+        log(f"BEST {name}: {t*1e3:.2f} ms  ({N/t/1e6:.0f}M rows/s)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,113 @@
+"""Config 5 phase budget (VERDICT r4 item 3): name the post-round-4 wall.
+
+Measures, at the exact suite config-5 workload (100k replicas, ~190k
+ops, 83k encrypted files):
+
+  decrypt   — batch AEAD open of every payload (no decode)
+  decode    — native columnar decode of pre-decrypted chunks (feed)
+  fold+wb   — the combined sparse fold + state writeback (finish),
+              sub-split by the trace spans underneath
+  e2e       — the real overlapped pipeline (decrypt lookahead ‖ decode)
+
+Prints one JSON line with the table; run on an idle box.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from benchmarks.suite import _build_encrypted_files  # noqa: E402
+from crdt_enc_tpu.backends.xchacha import (  # noqa: E402
+    decrypt_blobs, decrypt_blobs_chunked,
+)
+from crdt_enc_tpu.models import ORSet  # noqa: E402
+from crdt_enc_tpu.parallel import TpuAccelerator  # noqa: E402
+from crdt_enc_tpu.utils import codec, trace  # noqa: E402
+
+
+def best_of(fn, iters=3):
+    out = None
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    N, R, E, ops_per_file = 200_000, 100_000, 1024, 48
+    key = secrets.token_bytes(32)
+    payloads, plain, headers, actors = _build_encrypted_files(
+        N, R, E, ops_per_file, key, n_headers=6
+    )
+    total_ops = sum(len(codec.unpack(p)) for p in plain)
+    accel = TpuAccelerator()
+    actors_sorted = sorted(actors)
+    print(f"files={len(payloads)} ops={total_ops}", file=sys.stderr)
+
+    # ---- decrypt alone (batch API the pipeline uses, one pass)
+    t_decrypt, cleartexts = best_of(lambda: decrypt_blobs(key, payloads))
+
+    # ---- decode alone: feed pre-decrypted chunks, never finish
+    n_chunks = 8
+    cuts = np.linspace(0, len(cleartexts), n_chunks + 1).astype(int)
+    chunks = [cleartexts[a:b] for a, b in zip(cuts[:-1], cuts[1:])]
+
+    def decode_only():
+        stream = accel.open_payload_stream(ORSet(), actors_hint=actors_sorted)
+        for ch in chunks:
+            assert stream.feed(ch)
+        return stream
+
+    t_decode, stream = best_of(decode_only)
+
+    # ---- fold + writeback: finish() on a fed stream, trace-sub-split
+    def fold_wb():
+        st = decode_only()
+        trace.reset()
+        t0 = time.perf_counter()
+        assert st.finish()
+        return time.perf_counter() - t0
+
+    t_finish = min(fold_wb() for _ in range(3))
+    spans = {
+        k: round(v["seconds"], 4)
+        for k, v in trace.snapshot().get("spans", {}).items()
+    }
+
+    # ---- real overlapped pipeline (the suite's device path)
+    def pipeline():
+        folded = ORSet()
+        ch = decrypt_blobs_chunked(key, payloads, n_chunks=n_chunks)
+        assert accel.fold_payload_stream(folded, ch, actors_hint=actors_sorted)
+        return folded
+
+    pipeline()  # warm
+    t_e2e, folded = best_of(pipeline)
+
+    table = {
+        "config": "mixed_streaming_100k_phases",
+        "files": len(payloads),
+        "ops": total_ops,
+        "decrypt_s": round(t_decrypt, 4),
+        "decode_s": round(t_decode, 4),
+        "fold_writeback_s": round(t_finish, 4),
+        "fold_spans_s": spans,
+        "e2e_overlapped_s": round(t_e2e, 4),
+        "e2e_rate_ops_s": round(total_ops / t_e2e, 1),
+        "sum_phases_s": round(t_decrypt + t_decode + t_finish, 4),
+    }
+    print(json.dumps(table))
+
+
+if __name__ == "__main__":
+    main()

@@ -416,7 +416,7 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int,
     # pack into one (R * V = 1M ≪ 2^31)
     n_values = int(value.max()) + 1
 
-    def make_chained_impl(impl, tile_cap):
+    def make_chained_impl(impl, tile_cap, limbs=None):
         def make_chained(n):
             @jax.jit
             def run(key, hi, lo, actor, value):
@@ -444,7 +444,7 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int,
                         K.lww_fold_into(
                             carry, *rolled,
                             num_keys=K_keys, num_values=n_values,
-                            impl=impl, tile_cap=tile_cap,
+                            impl=impl, tile_cap=tile_cap, limbs=limbs,
                         ),
                         (),
                     )
@@ -465,19 +465,24 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int,
         # the Pallas winner fold (ops/pallas_lww.py): time it as a second
         # variant and take the better, gated on exact equality with the
         # XLA fold on the full batch (parity is also pinned in tests)
-        from crdt_enc_tpu.ops.pallas_lww import lww_fold_pallas, lww_tile_cap
+        from crdt_enc_tpu.ops.pallas_lww import (
+            lww_fold_pallas, lww_limbs, lww_tile_cap,
+        )
 
         cap = lww_tile_cap(key, K_keys)
+        limbs = lww_limbs(hi, lo, actor, n_values)
         ref_tbl = K.lww_fold(*args, num_keys=K_keys, num_values=n_values)
         pal_tbl = lww_fold_pallas(
-            *args, num_keys=K_keys, num_values=n_values, tile_cap=cap
+            *args, num_keys=K_keys, num_values=n_values, tile_cap=cap,
+            limbs=limbs,
         )
         pallas_ok = all(
             bool(jnp.array_equal(a, b)) for a, b in zip(ref_tbl, pal_tbl)
         )
         if pallas_ok:
             t_pal, timing_pal = timeit_marginal(
-                make_chained_impl("pallas", cap), iters, chain=20 * cmul
+                make_chained_impl("pallas", cap, limbs), iters,
+                chain=20 * cmul,
             )
             log(f"  lww pallas marginal {t_pal * 1e3:.2f}ms vs xla "
                 f"{t_dev * 1e3:.2f}ms")
@@ -521,6 +526,7 @@ def bench_lwwmap(N: int, K_keys: int, R: int, n_host: int, iters: int,
     equal = (dev_map == state) and inc_equal
     return dict(
         config="lwwmap_1Mx10k", metric="writes_folded_per_sec", N=N,
+        _pin_shape=dict(N=N, K=K_keys, R=R, n_host=n_host),
         K=K_keys, R=R,
         host_rate=n_host / t_host, device_rate=N / t_dev, byte_equal=bool(equal),
         timing=timing, variant=lww_variant,

@@ -104,7 +104,7 @@ def lww_table_merge(a: tuple, b: tuple) -> tuple:
 @partial(
     jax.jit,
     static_argnames=("num_keys", "num_values", "impl", "tile_cap",
-                     "interpret"),
+                     "interpret", "limbs"),
 )
 def lww_fold_into(
     win: tuple,  # (win_hi, win_lo, win_actor, win_value, present) — (K,) each
@@ -119,6 +119,9 @@ def lww_fold_into(
     impl: str = "xla",  # "xla" (cascaded segment-max) | "pallas" (MXU)
     tile_cap: int = 1 << 14,  # pallas only: ops/pallas_lww.lww_tile_cap
     interpret: bool = False,
+    limbs: tuple | None = None,  # pallas only: static per-column limb
+    #   counts (ops/pallas_lww.lww_limbs) — measured ~4x the kernel at
+    #   the config-4 shape vs the data-dependent limb conds
 ):
     """Incremental fold: new rows compete against an existing winner table.
 
@@ -139,7 +142,7 @@ def lww_fold_into(
         new = lww_fold_pallas(
             key, ts_hi, ts_lo, actor, value,
             num_keys=num_keys, num_values=num_values,
-            tile_cap=tile_cap, interpret=interpret,
+            tile_cap=tile_cap, interpret=interpret, limbs=limbs,
         )
     else:
         new = lww_fold(

@@ -61,6 +61,7 @@ def _lww_tile_kernel(
     e1lo_ref, e1hi_ref, e2lo_ref, e2hi_ref, e3lo_ref, e3hi_ref,  # columns
     out1_ref, out2_ref, out3_ref,  # (1, 128, 128) int32
     *, BLK: int, dot_dtype, win_mode: str = "cond",
+    limbs: tuple | None = None,
 ):
     t = pl.program_id(0)
     lo = edges_ref[t]
@@ -107,9 +108,8 @@ def _lww_tile_kernel(
         A_T = (row == iota128).astype(dot_dtype)  # shared by all columns
         hot = lane == iota128
 
-        def col(e_lo, e_hi, out_ref):
+        def col(e_lo, e_hi, out_ref, n_limbs):
             v = jnp.where(ok, load(e_lo, e_hi, local, in_hi), 0)
-            vmax = jnp.max(v)
 
             def limb(shift):
                 piece = hot * ((v >> shift) & 0xFF).astype(dot_dtype)
@@ -118,6 +118,18 @@ def _lww_tile_kernel(
                 )
                 return p.astype(jnp.int32) << shift
 
+            if n_limbs is not None:
+                # static limb count (round 5): the caller knows each
+                # column's max host-side, so the 3 per-column conds +
+                # max-reduce per chunk — 12 serializing branches per
+                # visit — compile away entirely
+                acc = limb(0)
+                for i in range(1, n_limbs):
+                    acc = acc + limb(i * _LIMB)
+                out_ref[0] += acc
+                return
+
+            vmax = jnp.max(v)
             # limb 0 always; higher limbs only when some row needs them
             acc = limb(0)
             acc = jax.lax.cond(
@@ -137,9 +149,10 @@ def _lww_tile_kernel(
             )
             out_ref[0] += acc
 
-        col(e1lo_ref, e1hi_ref, out1_ref)
-        col(e2lo_ref, e2hi_ref, out2_ref)
-        col(e3lo_ref, e3hi_ref, out3_ref)
+        lb = limbs or (None, None, None)
+        col(e1lo_ref, e1hi_ref, out1_ref, lb[0])
+        col(e2lo_ref, e2hi_ref, out2_ref, lb[1])
+        col(e3lo_ref, e3hi_ref, out3_ref, lb[2])
         return 0
 
     start_j = lo // SUB
@@ -159,6 +172,9 @@ def lww_fold_pallas(
     tile_cap: int | None = None,  # ≥ max rows in any 16384-key tile
     interpret: bool = False,
     win_mode: str = "cond",  # "cond" | "select" (branchless window loads)
+    limbs: tuple | None = None,  # static per-column limb counts
+    #   (hi, lo, av) from lww_limbs — kills 12 serializing in-kernel
+    #   branches per chunk; None keeps the data-dependent conds
 ):
     """Drop-in for ``lww_fold(..., num_values=V)`` (same contract,
     including the packed (actor, value) rank cascade — the caller
@@ -185,18 +201,34 @@ def lww_fold_pallas(
     return _lww_fold_pallas_impl(
         key, ts_hi, ts_lo, actor, value, num_keys=num_keys,
         num_values=num_values, tile_cap=tile_cap, interpret=interpret,
-        win_mode=win_mode,
+        win_mode=win_mode, limbs=limbs,
     )
+
+
+def lww_limbs(ts_hi, ts_lo, actor, num_values: int) -> tuple:
+    """Static per-column limb counts for ``lww_fold_pallas`` from the
+    batch's host-side maxima (upper bounds are fine — extra limbs cost
+    matmuls, missing limbs would corrupt, so bounds only round UP)."""
+    import numpy as np
+
+    def nl(mx: int) -> int:
+        return max(1, (int(mx).bit_length() + _LIMB - 1) // _LIMB)
+
+    m_hi = int(np.max(ts_hi, initial=0))
+    m_lo = int(np.max(ts_lo, initial=0))
+    m_av = (int(np.max(actor, initial=0)) + 1) * num_values  # ≥ max av+1
+    return (nl(m_hi), nl(m_lo), nl(m_av))
 
 
 @partial(
     jax.jit,
     static_argnames=("num_keys", "num_values", "tile_cap", "interpret",
-                     "win_mode"),
+                     "win_mode", "limbs"),
 )
 def _lww_fold_pallas_impl(
     key, ts_hi, ts_lo, actor, value,
     *, num_keys, num_values, tile_cap, interpret, win_mode="cond",
+    limbs=None,
 ):
     K, V = num_keys, num_values
     N = key.shape[0]
@@ -261,7 +293,7 @@ def _lww_fold_pallas_impl(
     )
     out_hi, out_lo, out_av = pl.pallas_call(
         partial(_lww_tile_kernel, BLK=BLK, dot_dtype=jnp.bfloat16,
-                win_mode=win_mode),
+                win_mode=win_mode, limbs=limbs),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((T, LANE, LANE), jnp.int32)] * 3,
         interpret=interpret,

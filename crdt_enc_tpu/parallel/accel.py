@@ -760,12 +760,17 @@ class TpuAccelerator(HostAccelerator):
             V = len(cols.values_sorted)
             num_values = V if len(cols.actors_sorted) * V < 2**31 else None
             if self._lww_pallas_eligible(num_values, hi, len(key_col)):
-                from ..ops.pallas_lww import lww_fold_pallas, lww_tile_cap
+                from ..ops.pallas_lww import (
+                    lww_fold_pallas, lww_limbs, lww_tile_cap,
+                )
 
                 m_hi, m_lo, m_actor, m_value, present = lww_fold_pallas(
                     key_col, hi, lo, actor_col, value_col,
                     num_keys=Kn, num_values=num_values,
                     tile_cap=lww_tile_cap(key_col, Kn),
+                    # static limb counts from the batch's host-side maxima:
+                    # the in-kernel per-chunk limb conds measured 4x slower
+                    limbs=lww_limbs(hi, lo, actor_col, num_values),
                 )
             else:
                 m_hi, m_lo, m_actor, m_value, present = K.lww_fold(
