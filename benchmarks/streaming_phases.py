@@ -54,17 +54,25 @@ def main():
     actors_sorted = sorted(actors)
     print(f"files={len(payloads)} ops={total_ops}", file=sys.stderr)
 
-    # ---- decrypt alone (batch API the pipeline uses, one pass)
-    t_decrypt, cleartexts = best_of(lambda: decrypt_blobs(key, payloads))
+    # ---- decrypt alone, in the pipeline's own form: per-chunk PACKED
+    # batch open (one cleartext buffer + offsets per chunk — the shape
+    # decrypt_blobs_chunked yields to the stream)
+    from crdt_enc_tpu.backends.xchacha import decrypt_blobs_packed
 
-    # ---- decode alone: feed pre-decrypted chunks, never finish
     n_chunks = 8
-    cuts = np.linspace(0, len(cleartexts), n_chunks + 1).astype(int)
-    chunks = [cleartexts[a:b] for a, b in zip(cuts[:-1], cuts[1:])]
+    chunk_blobs = max(1, -(-len(payloads) // n_chunks))
+    spans_b = [payloads[i:i + chunk_blobs]
+               for i in range(0, len(payloads), chunk_blobs)]
 
+    def decrypt_packed():
+        return [decrypt_blobs_packed(key, s) for s in spans_b]
+
+    t_decrypt, packed_chunks = best_of(decrypt_packed)
+
+    # ---- decode alone: feed the pre-decrypted packed chunks, no finish
     def decode_only():
         stream = accel.open_payload_stream(ORSet(), actors_hint=actors_sorted)
-        for ch in chunks:
+        for ch in packed_chunks:
             assert stream.feed(ch)
         return stream
 

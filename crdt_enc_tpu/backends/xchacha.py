@@ -130,7 +130,18 @@ def decrypt_blobs_packed(key: bytes, blobs: list, n_threads: int = 0):
     ct_offs = np.zeros(n, np.uint64)
     ct_lens = np.zeros(n, np.uint64)
     vp, _v = native.in_ptr(XCHACHA_DATA_VERSION_1)
-    blens = np.fromiter((len(b) for b in blobs), np.uint64, count=n)
+    blens = np.empty(n, np.uint64)
+    total_in = -1
+    try:  # one C-API pass for the lengths (round 5: np.fromiter over
+        # 83k Python len() calls cost ~5ms of the config-5 decrypt)
+        slib = native.load_state()
+        total_in = int(slib.bytes_lens_join(
+            blobs, blens.ctypes.data_as(native.u64p), None
+        ))
+    except Exception:
+        pass
+    if total_in < 0:  # non-bytes elements or no native lib
+        blens = np.fromiter((len(b) for b in blobs), np.uint64, count=n)
     # Pointer-array vs join: skipping the join is a pure memcpy win for
     # LARGE blobs (~40ms per 60MB on this host), but TINY blobs decrypt
     # ~1.3x FASTER from one contiguous buffer (scattered 300B heap reads
@@ -158,8 +169,19 @@ def decrypt_blobs_packed(key: bytes, blobs: list, n_threads: int = 0):
     else:
         boffs = np.zeros(n + 1, np.uint64)
         np.cumsum(blens, out=boffs[1:])
-        big = b"".join(blobs)
-        bp, _b = native.in_ptr(big)
+        if total_in >= 0:
+            # native join straight into one buffer (skips b"".join's
+            # second list walk; same single-memcpy-per-blob cost)
+            big = np.empty(total_in, np.uint8)
+            slib.bytes_lens_join(
+                blobs, blens.ctypes.data_as(native.u64p),
+                big.ctypes.data_as(native.u8p),
+            )
+            bp = big.ctypes.data_as(native.u8p)
+            _b = big
+        else:
+            big = b"".join(blobs)
+            bp, _b = native.in_ptr(big)
         total_clear = int(lib.encbox_parse_batch(
             bp, boffs.ctypes.data_as(native.u64p), n, vp,
             nonce_offs.ctypes.data_as(native.u64p),
@@ -294,6 +316,14 @@ def decrypt_blobs_chunked(
         return packed if packed is not None else decrypt_blobs(
             key, span, n_threads
         )
+
+    if (os.cpu_count() or 1) <= 1:
+        # one core: the lookahead thread cannot overlap anything real —
+        # it only adds executor/context-switch overhead (~8ms at the
+        # config-5 shape, measured round 5) — so decrypt synchronously
+        for span in spans:
+            yield open_chunk(span)
+        return
 
     with ThreadPoolExecutor(1) as ex:
         fut = ex.submit(open_chunk, spans[0])

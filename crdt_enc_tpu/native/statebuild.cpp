@@ -428,6 +428,29 @@ PyObject* canon_pack(PyObject* obj) {
 
 }  // extern "C" (canon_pack; the outer linkage block continues below)
 
+// One pass over a list of bytes objects: write each length into
+// ``lens`` and (when ``out`` is non-null) memcpy the payloads
+// back-to-back into ``out``.  Returns the total byte count, or -1 when
+// any element is not exactly ``bytes`` (caller falls back to Python).
+// Replaces a np.fromiter(len, ...) + b"".join() pair that cost ~9ms at
+// the 83k-tiny-blob config-5 shape (round-5 phase profile).
+int64_t bytes_lens_join(PyObject* seq, uint64_t* lens, uint8_t* out) {
+    if (!PyList_CheckExact(seq)) return -1;
+    Py_ssize_t n = PyList_GET_SIZE(seq);
+    int64_t total = 0;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* b = PyList_GET_ITEM(seq, i);
+        if (!PyBytes_CheckExact(b)) return -1;
+        Py_ssize_t ln = PyBytes_GET_SIZE(b);
+        lens[i] = (uint64_t)ln;
+        if (out) {
+            memcpy(out + total, PyBytes_AS_STRING(b), (size_t)ln);
+        }
+        total += (int64_t)ln;
+    }
+    return total;
+}
+
 // Build {actor_obj: counter} for the nonzero entries of a dense clock —
 // the native twin of ops/columnar.py dense_to_vclock's dict body.
 // Returns a NEW dict, or NULL on error.
