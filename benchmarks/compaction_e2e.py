@@ -97,6 +97,11 @@ async def main() -> None:
     ap.add_argument("--members", type=int, default=512)
     ap.add_argument("--build-into", help="(internal) build the remote under this dir and exit")
     ap.add_argument(
+        "--skip-host", action="store_true",
+        help="profiling mode: skip the (minutes-long at full scale) host "
+        "compaction; byte equality is then cold==warm only",
+    )
+    ap.add_argument(
         "--compact-one", nargs=3, metavar=("LOCAL", "REMOTE", "ACCEL"),
         help="(internal) run one timed compaction (ACCEL: host|tpu) and print JSON",
     )
@@ -112,20 +117,34 @@ async def main() -> None:
 
     if args.compact_one:
         import hashlib
+        import os
         import resource
 
         import crdt_enc_tpu
         from crdt_enc_tpu.parallel import TpuAccelerator
+        from crdt_enc_tpu.utils import trace
 
         crdt_enc_tpu.enable_compilation_cache()
         local, remote, kind = args.compact_one
         accel = TpuAccelerator() if kind == "tpu" else None
+        profile = os.environ.get("COMPACT_PROFILE") == "1"
+        if profile:
+            trace.reset()
         wall, state_bytes = await timed_compact(Path(local), Path(remote), accel)
-        print(json.dumps({
+        rec = {
             "wall": wall,
             "rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024,
             "digest": hashlib.sha256(state_bytes).hexdigest(),
-        }))
+        }
+        if profile:
+            snap = trace.snapshot()
+            rec["spans"] = {
+                k: round(v["seconds"], 3)
+                for k, v in sorted(snap["spans"].items())
+            }
+            rec["counters"] = snap["counters"]
+            log(trace.report())
+        print(json.dumps(rec))
         return
 
     base = Path(tempfile.mkdtemp(prefix="compact-e2e-"))
@@ -171,33 +190,46 @@ async def main() -> None:
         if r.returncode != 0:
             log(r.stderr)
             raise RuntimeError(f"{kind} compaction child failed")
+        for ln in r.stderr.splitlines():  # the COMPACT_PROFILE span table
+            log(f"  [{kind}] {ln}")
         return json.loads(r.stdout.strip().splitlines()[-1])
 
-    host = compact_child(base / "reader-host", remote_host, "host")
-    log(f"host compact: {host['wall']:.2f}s -> "
-        f"{total / host['wall']:,.0f} ops/s e2e ({host['rss_mb']:.0f}MB)")
+    if args.skip_host:
+        host = None
+    else:
+        host = compact_child(base / "reader-host", remote_host, "host")
+        log(f"host compact: {host['wall']:.2f}s -> "
+            f"{total / host['wall']:,.0f} ops/s e2e ({host['rss_mb']:.0f}MB)")
     cold = compact_child(base / "reader-tpu-cold", remote_tpu_cold, "tpu")
     log(f"tpu  compact (cold process): {cold['wall']:.2f}s")
     warm = compact_child(base / "reader-tpu", remote_tpu_warm, "tpu")
     log(f"tpu  compact (warm): {warm['wall']:.2f}s -> "
         f"{total / warm['wall']:,.0f} ops/s e2e ({warm['rss_mb']:.0f}MB)")
 
-    equal = host["digest"] == cold["digest"] == warm["digest"]
+    equal = cold["digest"] == warm["digest"] and (
+        host is None or host["digest"] == cold["digest"]
+    )
     shutil.rmtree(base, ignore_errors=True)
-    print(json.dumps({
+    rec = {
         "metric": "compaction_e2e_ops_per_sec",
         "n_files": n_files,
         "n_ops": total,
-        "host_wall_s": round(host["wall"], 3),
         "tpu_wall_s": round(warm["wall"], 3),
         "tpu_cold_wall_s": round(cold["wall"], 3),
         "value": round(total / warm["wall"], 1),
         "unit": "ops/s",
-        "vs_baseline": round(host["wall"] / warm["wall"], 2),
         "byte_equal": bool(equal),
-        "host_rss_mb": round(host["rss_mb"], 1),
         "tpu_rss_mb": round(warm["rss_mb"], 1),
-    }))
+    }
+    if host is not None:
+        rec.update(
+            host_wall_s=round(host["wall"], 3),
+            vs_baseline=round(host["wall"] / warm["wall"], 2),
+            host_rss_mb=round(host["rss_mb"], 1),
+        )
+    if "spans" in warm:
+        rec["tpu_spans"] = warm["spans"]
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
