@@ -618,18 +618,45 @@ def main():
     # under the floor is NOISE — it must not win "best" and its rate must
     # not be published; raise BENCH_CHAIN until the signal clears the floor.
     NOISE_FLOOR = TUNNEL_JITTER_S / CHAIN
-    variants, single_dispatch = {}, {}
+    # Round-robin timing (round 5): single-position measurements swing
+    # ±2-3ms with device/tunnel weather, so sequential per-variant
+    # timing hands the last-measured variant the weather lottery.
+    # Compile everything first, then interleave BENCH_ROUNDS passes
+    # across variants and keep per-variant minima — variants compete
+    # under the same weather.
+    ROUNDS = int(os.environ.get("BENCH_ROUNDS", 2))
+    fns = {}
     for name, kw in variant_kws.items():
-        t1 = timed(chained(1, **kw))
-        tk = timed(chained(1 + CHAIN, **kw))
-        t_marginal = (tk - t1) / CHAIN
+        fns[name] = (chained(1, **kw), chained(1 + CHAIN, **kw))
+        for f in fns[name]:
+            import jax as _jax
+
+            _jax.block_until_ready(f(*args))  # compile now
+        log(f"compiled {name}")
+    variants, single_dispatch = {}, {}
+    for rd in range(ROUNDS):
+        for name in variant_kws:
+            f1, fk = fns[name]
+            t1 = timed(f1)
+            tk = timed(fk)
+            t_marginal = (tk - t1) / CHAIN
+            single_dispatch[name] = min(
+                single_dispatch.get(name, t1), t1
+            )
+            if name in variants:
+                variants[name] = min(variants[name], t_marginal)
+            else:
+                variants[name] = t_marginal
+            log(f"  round {rd} {name}: {t_marginal * 1e3:.2f} ms")
+    for name in list(variants):
+        t_marginal = variants[name]
         reliable = t_marginal > NOISE_FLOOR
-        single_dispatch[name] = t1
-        if reliable:
-            variants[name] = t_marginal
+        if not reliable:
+            del variants[name]
         log(
-            f"tpu[{name}]: single-dispatch {t1:.4f}s (incl. ~0.1s tunnel "
-            f"round-trip); marginal {t_marginal * 1e3:.2f}ms/fold → "
+            f"tpu[{name}]: single-dispatch {single_dispatch[name]:.4f}s "
+            f"(incl. ~0.1s tunnel round-trip); best marginal "
+            f"{t_marginal * 1e3:.2f}ms/fold → "
             f"{N / max(t_marginal, 1e-9):,.0f} ops/s"
             + ("" if reliable else "  [below noise floor — excluded]")
         )

@@ -582,6 +582,15 @@ def fused_sections(which):
             ("fused skip/8 defer hblk32 subk512",
              mk_fused("skip", 8, False, 32, 512)),
         ]
+    if os.environ.get("MB_FUSED_HBLK2") == "1" and skip_ok:
+        # round-5 second sweep: larger actor blocks cut n_segs further
+        # (48 → A_BLK=2, 64 → A_BLK=2 at R=10k) at the cost of taller
+        # one-hots (384/512 rows — VPU/MXU still far from the wall)
+        variants = [
+            ("fused skip/8 defer hblk32", mk_fused("skip", 8, False, 32)),
+            ("fused skip/8 defer hblk48", mk_fused("skip", 8, False, 48)),
+            ("fused skip/8 defer hblk64", mk_fused("skip", 8, False, 64)),
+        ]
 
     # single-variant measurements swing ±2-3ms between positions in one
     # process (device/tunnel weather).  Protocol: compile everything
