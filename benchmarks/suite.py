@@ -302,14 +302,25 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1,
     interpret = jax.default_backend() != "tpu"
     use_pallas = counter.max() < MAX_COUNTER and N <= MAX_ROWS
     if use_pallas:
+        # round 5: the fused-tail kernel with host-routed defaults —
+        # the same flagship path bench.py publishes (pad/unpad ride
+        # inside the fold here; the bench's padded chain amortizes them)
+        from crdt_enc_tpu.ops.pallas_fold import (
+            fused_defaults, orset_fold_pallas_fused, orset_pad_state,
+            orset_unpad_state,
+        )
+
         tile_cap = fold_cap(member, E)
+        fd = fused_defaults(E, R, int(counter.max()))
 
         def fold(c, a, r, kind, member, actor, counter):
-            return orset_fold_pallas(
-                c, a, r, kind, member, actor, counter,
+            cp, ap, rp = orset_pad_state(
+                c, a, r, num_members=E, num_replicas=R, h_blk=fd["h_blk"])
+            out = orset_fold_pallas_fused(
+                cp, ap, rp, kind, member, actor, counter,
                 num_members=E, num_replicas=R, tile_cap=tile_cap,
-                interpret=interpret,
-            )
+                interpret=interpret, **fd)
+            return orset_unpad_state(*out, num_members=E, num_replicas=R)
     else:
         def fold(c, a, r, kind, member, actor, counter):
             return K.orset_fold(
@@ -343,6 +354,39 @@ def bench_orset(N: int, R: int, E: int, n_host: int, iters: int, cmul: int = 1,
 
     def make_chained(n):
         import jax.numpy as jnp
+
+        if use_pallas:
+            from crdt_enc_tpu.ops.pallas_fold import orset_retire
+
+            @jax.jit
+            def run(c, a, r, kind, member, actor, counter):
+                # padded-plane deferred chain, identical to bench.py's
+                # pallas_fused protocol: pad once, deferred rm
+                # retirement inside, one finalize after the scan
+                cp, ap, rp = orset_pad_state(
+                    c, a, r, num_members=E, num_replicas=R,
+                    h_blk=fd["h_blk"])
+
+                def body(carry, _):
+                    shift = (carry[0][0] + carry[1][0, 0]) % jnp.int32(
+                        kind.shape[0]
+                    )
+                    rolled = [
+                        jnp.roll(x, shift)
+                        for x in (kind, member, actor, counter)
+                    ]
+                    out = orset_fold_pallas_fused(
+                        cp, ap, rp, *rolled,
+                        num_members=E, num_replicas=R, tile_cap=tile_cap,
+                        interpret=interpret, retire_rm=False, **fd)
+                    return out, ()
+                carry, _ = jax.lax.scan(
+                    body, (cp, ap, rp), None, length=n)
+                ck, ad, rmv = carry
+                return orset_unpad_state(
+                    ck, ad, orset_retire(ck, rmv),
+                    num_members=E, num_replicas=R)
+            return lambda: run(*args)
 
         @jax.jit
         def run(c, a, r, kind, member, actor, counter):
