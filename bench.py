@@ -236,11 +236,16 @@ def pinned_ratio_fields(config: str, shape: dict, device_rate: float,
     rec = load_pinned(config, shape)
     out = {"vs_same_run_host": round(same_run_ratio, 2)}
     if rec:
-        out["vs_pinned_baseline"] = round(device_rate / rec["host_rate"], 2)
+        raw = device_rate / rec["host_rate"]
+        out["vs_pinned_baseline"] = round(raw, 2)
         out["pinned_host_rate"] = rec["host_rate"]
         out["vs_baseline"] = out["vs_pinned_baseline"]
     else:
+        raw = same_run_ratio
         out["vs_baseline"] = round(same_run_ratio, 2)
+    # full-precision ratio for aggregation (geomeans must not
+    # accumulate display rounding); underscore = not a record field
+    out["_ratio_raw"] = raw
     return out
 
 
@@ -699,6 +704,7 @@ def main():
         "orset_10kx1M", {"N": N, "R": R, "E": E, "n_host": N_HOST},
         tpu_rate, tpu_rate / host_rate,
     )
+    ratio_fields.pop("_ratio_raw", None)  # aggregation-only field
     result = {
         "metric": "orset_compaction_fold_ops_per_sec",
         "value": round(tpu_rate, 1),

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import shutil
 import sys
 import tempfile
@@ -190,8 +191,9 @@ async def main() -> None:
         if r.returncode != 0:
             log(r.stderr)
             raise RuntimeError(f"{kind} compaction child failed")
-        for ln in r.stderr.splitlines():  # the COMPACT_PROFILE span table
-            log(f"  [{kind}] {ln}")
+        if os.environ.get("COMPACT_PROFILE") == "1":
+            for ln in r.stderr.splitlines():  # the span table
+                log(f"  [{kind}] {ln}")
         return json.loads(r.stdout.strip().splitlines()[-1])
 
     if args.skip_host:

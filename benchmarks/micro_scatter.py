@@ -41,6 +41,40 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def round_robin(variants, rounds_env="MB_FUSED_ROUNDS", rounds_default=6):
+    """The interleaved A/B protocol (round 5): single-position marginal
+    measurements swing ±2-3ms with device/tunnel weather, so compile
+    every variant FIRST, then rotate timing passes across variants and
+    keep per-variant minima — only interleaved comparisons count.
+    ``variants`` is [(name, mk)] where mk(n) builds the n-fold chain."""
+    rounds = int(os.environ.get(rounds_env, rounds_default))
+    fns = {}
+    for name, mk in variants:
+        fns[name] = (mk(1), mk(1 + CHAIN))
+        for f in fns[name]:
+            jax.block_until_ready(f())  # compile now
+        log(f"compiled {name}")
+
+    def time_once(fn):
+        ts = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            force_completion(out)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    best = {name: float("inf") for name, _ in variants}
+    for rd in range(rounds):
+        for name, _ in variants:
+            f1, fk = fns[name]
+            t = (time_once(fk) - time_once(f1)) / CHAIN
+            best[name] = min(best[name], t)
+            log(f"  round {rd} {name}: {t*1e3:.2f} ms")
+    return best
+
+
 def marginal(make_chain):
     def timed(fn):
         out = fn()
@@ -592,35 +626,7 @@ def fused_sections(which):
             ("fused skip/8 defer hblk64", mk_fused("skip", 8, False, 64)),
         ]
 
-    # single-variant measurements swing ±2-3ms between positions in one
-    # process (device/tunnel weather).  Protocol: compile everything
-    # ONCE, then round-robin the timing across variants several times
-    # and keep per-variant minima — only interleaved comparisons count.
-    rounds = int(os.environ.get("MB_FUSED_ROUNDS", 6))
-    fns = {}
-    for name, mk in variants:
-        fns[name] = (mk(1), mk(1 + CHAIN))
-        for f in fns[name]:
-            jax.block_until_ready(f())  # compile now
-        log(f"compiled {name}")
-
-    def time_once(fn):
-        ts = []
-        for _ in range(ITERS):
-            t0 = time.perf_counter()
-            out = fn()
-            jax.block_until_ready(out)
-            force_completion(out)
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
-
-    best = {name: float("inf") for name, _ in variants}
-    for rd in range(rounds):
-        for name, _ in variants:
-            f1, fk = fns[name]
-            t = (time_once(fk) - time_once(f1)) / CHAIN
-            best[name] = min(best[name], t)
-            log(f"  round {rd} {name}: {t*1e3:.2f} ms")
+    best = round_robin(variants)
     for name, _ in variants:
         t = best[name]
         log(f"BEST {name}: {t*1e3:.2f} ms ({N/t/1e6:.0f}M ops/s)")
@@ -689,31 +695,7 @@ def lww_sections(which):
         ("lww cond static-limb", mk_fold("cond", lb)),
         ("lww select static-limb", mk_fold("select", lb)),
     ]
-    rounds = int(os.environ.get("MB_FUSED_ROUNDS", 4))
-    fns = {}
-    for name, mk in variants:
-        fns[name] = (mk(1), mk(1 + CHAIN))
-        for f in fns[name]:
-            jax.block_until_ready(f())
-        log(f"compiled {name}")
-
-    def time_once(fn):
-        ts = []
-        for _ in range(ITERS):
-            t0 = time.perf_counter()
-            out = fn()
-            jax.block_until_ready(out)
-            force_completion(out)
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
-
-    best = {name: float("inf") for name, _ in variants}
-    for rd in range(rounds):
-        for name, _ in variants:
-            f1, fk = fns[name]
-            t = (time_once(fk) - time_once(f1)) / CHAIN
-            best[name] = min(best[name], t)
-            log(f"  round {rd} {name}: {t*1e3:.2f} ms")
+    best = round_robin(variants, rounds_default=4)
     for name, _ in variants:
         t = best[name]
         log(f"BEST {name}: {t*1e3:.2f} ms  ({N/t/1e6:.0f}M rows/s)")

@@ -795,14 +795,16 @@ def main():
             r["device_rate"], r["device_rate"] / r["host_rate"],
         ))
         if r["super_roofline"]:
+            r.pop("_ratio_raw", None)  # excluded — and never published
             log(
                 f"WARNING: config {c} marginal implies {pct:.0f}% of HBM "
                 "peak — impossible (hoisted chain); excluded from geomean"
             )
         else:
             # the geomean of record uses the pinned denominator when
-            # available (VERDICT r4: same-run host rates swing 1.5×)
-            ratios.append(r["vs_baseline"])
+            # available (VERDICT r4: same-run host rates swing 1.5×),
+            # at full precision (not the 2-decimal display rounding)
+            ratios.append(r.pop("_ratio_raw"))
         r["host_rate"] = round(r["host_rate"], 1)
         r["device_rate"] = round(r["device_rate"], 1)
         results.append(r)

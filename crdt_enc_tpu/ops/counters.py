@@ -90,12 +90,14 @@ def pncounter_fold(
     actor_ix = jnp.minimum(actor, R - 1)
     if actor.shape[0] >= SORTED_MIN_ROWS:
         # ONE sort serves both planes: key interleaves (actor, plane),
-        # pads sort to the 2R sentinel; deinterleave by reshape
+        # pads AND out-of-domain signs sort to the 2R sentinel (the
+        # scatter route drops sign ∉ {POS, NEG} — both routes must)
+        valid = ~pad & ((sign == POS) | (sign == NEG))
         key = jnp.where(
-            pad, 2 * R, actor_ix * 2 + (sign == NEG).astype(jnp.int32)
+            valid, actor_ix * 2 + (sign == NEG).astype(jnp.int32), 2 * R
         )
         both = _sorted_segment_max(
-            key, jnp.where(pad, 0, counter), 2 * R
+            key, jnp.where(valid, counter, 0), 2 * R
         ).reshape(R, 2)
         p_new, n_new = both[:, 0], both[:, 1]
     else:
