@@ -638,7 +638,7 @@ def main():
 
             _jax.block_until_ready(f(*args))  # compile now
         log(f"compiled {name}")
-    variants, single_dispatch = {}, {}
+    variants, single_dispatch, sub_floor = {}, {}, {}
     for rd in range(ROUNDS):
         for name in variant_kws:
             f1, fk = fns[name]
@@ -648,23 +648,34 @@ def main():
             single_dispatch[name] = min(
                 single_dispatch.get(name, t1), t1
             )
+            if t_marginal <= NOISE_FLOOR:
+                # a single glitchy pass (tunnel hiccup inflating t1)
+                # must not poison the variant's minimum — the sample is
+                # noise, not device time; the variant is only excluded
+                # when EVERY pass lands sub-floor
+                sub_floor[name] = t_marginal
+                log(f"  round {rd} {name}: {t_marginal * 1e3:.2f} ms "
+                    "[sub-floor sample discarded]")
+                continue
             if name in variants:
                 variants[name] = min(variants[name], t_marginal)
             else:
                 variants[name] = t_marginal
             log(f"  round {rd} {name}: {t_marginal * 1e3:.2f} ms")
-    for name in list(variants):
-        t_marginal = variants[name]
-        reliable = t_marginal > NOISE_FLOOR
-        if not reliable:
-            del variants[name]
-        log(
-            f"tpu[{name}]: single-dispatch {single_dispatch[name]:.4f}s "
-            f"(incl. ~0.1s tunnel round-trip); best marginal "
-            f"{t_marginal * 1e3:.2f}ms/fold → "
-            f"{N / max(t_marginal, 1e-9):,.0f} ops/s"
-            + ("" if reliable else "  [below noise floor — excluded]")
-        )
+    for name in variant_kws:
+        if name in variants:
+            t_marginal = variants[name]
+            log(
+                f"tpu[{name}]: single-dispatch "
+                f"{single_dispatch[name]:.4f}s (incl. ~0.1s tunnel "
+                f"round-trip); best marginal {t_marginal * 1e3:.2f}ms/fold "
+                f"→ {N / t_marginal:,.0f} ops/s"
+            )
+        elif name in sub_floor:
+            log(
+                f"tpu[{name}]: every pass below the "
+                f"{NOISE_FLOOR * 1e3:.2f}ms noise floor — excluded"
+            )
     method = "marginal_chain"
     if not variants:
         log(
