@@ -638,7 +638,13 @@ def main():
 
             _jax.block_until_ready(f(*args))  # compile now
         log(f"compiled {name}")
-    variants, single_dispatch, sub_floor = {}, {}, {}
+    # Every pass's marginal is RECORDED per variant (the same
+    # transparency the host samples get): the published number is the
+    # min of the above-floor samples, and auditors can see the whole
+    # distribution — including discarded sub-floor glitches — in the
+    # evidence file, so a single-sample minimum can be judged against
+    # its siblings.
+    samples, single_dispatch = {n: [] for n in variant_kws}, {}
     for rd in range(ROUNDS):
         for name in variant_kws:
             f1, fk = fns[name]
@@ -648,34 +654,31 @@ def main():
             single_dispatch[name] = min(
                 single_dispatch.get(name, t1), t1
             )
-            if t_marginal <= NOISE_FLOOR:
-                # a single glitchy pass (tunnel hiccup inflating t1)
-                # must not poison the variant's minimum — the sample is
-                # noise, not device time; the variant is only excluded
-                # when EVERY pass lands sub-floor
-                sub_floor[name] = t_marginal
-                log(f"  round {rd} {name}: {t_marginal * 1e3:.2f} ms "
-                    "[sub-floor sample discarded]")
-                continue
-            if name in variants:
-                variants[name] = min(variants[name], t_marginal)
-            else:
-                variants[name] = t_marginal
-            log(f"  round {rd} {name}: {t_marginal * 1e3:.2f} ms")
-    for name in variant_kws:
-        if name in variants:
-            t_marginal = variants[name]
-            log(
-                f"tpu[{name}]: single-dispatch "
-                f"{single_dispatch[name]:.4f}s (incl. ~0.1s tunnel "
-                f"round-trip); best marginal {t_marginal * 1e3:.2f}ms/fold "
-                f"→ {N / t_marginal:,.0f} ops/s"
+            samples[name].append(t_marginal)
+            flag = (
+                "" if t_marginal > NOISE_FLOOR
+                else "  [sub-floor: noise, not device time]"
             )
-        elif name in sub_floor:
+            log(f"  round {rd} {name}: {t_marginal * 1e3:.2f} ms{flag}")
+    variants, sub_floor_discards = {}, {}
+    for name, ts in samples.items():
+        valid = [t for t in ts if t > NOISE_FLOOR]
+        sub_floor_discards[name] = len(ts) - len(valid)
+        if not valid:
             log(
                 f"tpu[{name}]: every pass below the "
                 f"{NOISE_FLOOR * 1e3:.2f}ms noise floor — excluded"
             )
+            continue
+        variants[name] = min(valid)
+        log(
+            f"tpu[{name}]: single-dispatch {single_dispatch[name]:.4f}s "
+            f"(incl. ~0.1s tunnel round-trip); best marginal "
+            f"{variants[name] * 1e3:.2f}ms/fold → "
+            f"{N / variants[name]:,.0f} ops/s"
+            + (f"  [{sub_floor_discards[name]} sub-floor discarded]"
+               if sub_floor_discards[name] else "")
+        )
     method = "marginal_chain"
     if not variants:
         log(
@@ -754,6 +757,13 @@ def main():
         **stats,
         "marginals_ms": {
             k: round(v * 1e3, 3) for k, v in variants.items()
+        },
+        # the full per-variant sample distributions (incl. sub-floor
+        # glitches), so a published minimum can be audited against its
+        # sibling passes — a lone fast outlier is visible as such
+        "marginal_samples_ms": {
+            k: [round(t * 1e3, 3) for t in ts]
+            for k, ts in samples.items()
         },
         "single_dispatch_s": {
             k: round(v, 4) for k, v in single_dispatch.items()
