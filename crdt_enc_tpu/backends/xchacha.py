@@ -12,10 +12,29 @@ holds no Python state so threads scale to the pool width.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import secrets
 
 from .. import native
+
+logger = logging.getLogger("crdt_enc_tpu.xchacha")
+
+_warned_no_native_lens = False
+
+
+def _warn_no_native_lens(exc: Exception) -> None:
+    """Log the native-lengths-pass fallback ONCE per process: the slow
+    path must be visible (a binding regression would otherwise silently
+    erase the optimization — ADVICE r5), but a box that simply cannot
+    build the C-API library must not spam every bulk decrypt."""
+    global _warned_no_native_lens
+    if not _warned_no_native_lens:
+        _warned_no_native_lens = True
+        logger.warning(
+            "native bytes_lens_join unavailable (%r); using the Python "
+            "lengths/join fallback for bulk decrypt", exc
+        )
 from ..core.cryptor import Cryptor
 from ..utils import VersionBytes, codec
 from ..utils.versions import XCHACHA_DATA_VERSION_1, XCHACHA_KEY_VERSION_1
@@ -133,13 +152,18 @@ def decrypt_blobs_packed(key: bytes, blobs: list, n_threads: int = 0):
     blens = np.empty(n, np.uint64)
     total_in = -1
     try:  # one C-API pass for the lengths (round 5: np.fromiter over
-        # 83k Python len() calls cost ~5ms of the config-5 decrypt)
+        # 83k Python len() calls cost ~5ms of the config-5 decrypt).
+        # expected_n bounds the blens write: a list grown since len()
+        # was taken returns -1 instead of running past the array
         slib = native.load_state()
         total_in = int(slib.bytes_lens_join(
-            blobs, blens.ctypes.data_as(native.u64p), None
+            blobs, blens.ctypes.data_as(native.u64p), None, 0, n
         ))
-    except Exception:
-        pass
+    except (OSError, AttributeError, RuntimeError) as e:
+        # expected unavailability only (dlopen/build failure, missing
+        # symbol) — anything else is a regression that must surface, not
+        # silently retire the fast path (ADVICE r5, low)
+        _warn_no_native_lens(e)
     if total_in < 0:  # non-bytes elements or no native lib
         blens = np.fromiter((len(b) for b in blobs), np.uint64, count=n)
     # Pointer-array vs join: skipping the join is a pure memcpy win for
@@ -167,21 +191,37 @@ def decrypt_blobs_packed(key: bytes, blobs: list, n_threads: int = 0):
         bp = ctypes.cast(0, native.u8p)
         _b = blobs  # keep every blob alive through the scatter call
     else:
-        boffs = np.zeros(n + 1, np.uint64)
-        np.cumsum(blens, out=boffs[1:])
         if total_in >= 0:
             # native join straight into one buffer (skips b"".join's
-            # second list walk; same single-memcpy-per-blob cost)
+            # second list walk; same single-memcpy-per-blob cost).  The
+            # join is element-count- and capacity-bounded and its return
+            # is verified against the lengths pass: pure Python ran
+            # between the two ctypes calls, so a caller that mutated
+            # ``blobs`` in that window must land on a clean restart, not
+            # a heap overrun or a partially-filled buffer (ADVICE r5,
+            # medium)
             big = np.empty(total_in, np.uint8)
-            slib.bytes_lens_join(
+            joined = int(slib.bytes_lens_join(
                 blobs, blens.ctypes.data_as(native.u64p),
-                big.ctypes.data_as(native.u8p),
-            )
+                big.ctypes.data_as(native.u8p), total_in, n,
+            ))
+            if joined != total_in:
+                # blobs changed between the passes: EVERY derived array
+                # above (blens, n itself) is stale — restart on a
+                # private snapshot of the list (the bytes elements are
+                # immutable, so the snapshot cannot race again)
+                return decrypt_blobs_packed(key, list(blobs), n_threads)
             bp = big.ctypes.data_as(native.u8p)
             _b = big
         else:
             big = b"".join(blobs)
             bp, _b = native.in_ptr(big)
+        # offsets AFTER the join, from the same pass that packed the
+        # buffer (the join refreshes blens in place): even a mutation
+        # that preserved n and the total cannot leave boffs misaligned
+        # with big — the frames parse exactly as packed
+        boffs = np.zeros(n + 1, np.uint64)
+        np.cumsum(blens, out=boffs[1:])
         total_clear = int(lib.encbox_parse_batch(
             bp, boffs.ctypes.data_as(native.u64p), n, vp,
             nonce_offs.ctypes.data_as(native.u64p),

@@ -560,10 +560,11 @@ class Core:
         q: asyncio.Queue = asyncio.Queue(maxsize=2)
 
         async def produce():
+            ci = 0  # chunk index: span meta, so overlap is event-auditable
             try:
                 async for files in self.storage.iter_op_chunks(wanted):
                     try:
-                        with trace.span("ops.chunk_unwrap"):
+                        with trace.span("ops.chunk_unwrap", meta=ci):
                             key_ids, middles = [], []
                             for _, _, raw in files:
                                 outer = VersionBytes.deserialize(
@@ -579,7 +580,7 @@ class Core:
                     for i, kid in enumerate(key_ids):
                         groups.setdefault(kid, []).append(i)
                     clears: list = [None] * len(files)
-                    with trace.span("ops.chunk_decrypt"):
+                    with trace.span("ops.chunk_decrypt", meta=ci):
                         for kid, idxs in groups.items():
                             key = self._data.keys.get_key(kid)
                             if key is None:
@@ -595,6 +596,7 @@ class Core:
                                 clears[i] = clear
                     trace.add("bytes_decrypted", sum(len(m) for m in middles))
                     await q.put(("chunk", files, clears))
+                    ci += 1
                 await q.put(("end",))
             except Exception as e:
                 await q.put(("error", e))
@@ -779,21 +781,24 @@ class Core:
                 material = keys[kid].material
                 CH = BULK_STREAM_CHUNK
                 slices = [idxs[i : i + CH] for i in range(0, len(idxs), CH)]
-                nxt = asyncio.create_task(
-                    self.cryptor.decrypt_batch(
-                        material, [middles[i] for i in slices[0]]
-                    )
-                )
+
+                async def decrypt_chunk(si):
+                    # per-chunk producer stage, span-tagged with the chunk
+                    # index so the overlap with the consumer's decode below
+                    # is auditable from the trace event log (the same
+                    # stream.* stage names the ops/stream.py pipeline and
+                    # bench.py --e2e-streaming use)
+                    with trace.span("stream.decrypt", meta=si):
+                        return await self.cryptor.decrypt_batch(
+                            material, [middles[i] for i in slices[si]]
+                        )
+
+                nxt = asyncio.create_task(decrypt_chunk(0))
                 try:
                     for si, sl in enumerate(slices):
                         clears = await nxt
                         nxt = (
-                            asyncio.create_task(
-                                self.cryptor.decrypt_batch(
-                                    material,
-                                    [middles[i] for i in slices[si + 1]],
-                                )
-                            )
+                            asyncio.create_task(decrypt_chunk(si + 1))
                             if si + 1 < len(slices)
                             else None
                         )
@@ -808,13 +813,15 @@ class Core:
                         # discipline as the pipelined path; an OpOrderError
                         # mid-batch must not strand validated-but-unfolded
                         # ops behind advanced cursors)
-                        p, m = self._validate_chunk(
-                            [files[i] for i in sl], clears, overlay
-                        )
+                        with trace.span("stream.validate", meta=si):
+                            p, m = self._validate_chunk(
+                                [files[i] for i in sl], clears, overlay
+                            )
                         metas.extend(m)
                         payload_chunks.append(p)
                         if streamed_ok:
-                            streamed_ok = stream.feed(p)
+                            with trace.span("stream.decode", meta=si):
+                                streamed_ok = stream.feed(p)
                 finally:
                     if nxt is not None:
                         nxt.cancel()
@@ -864,8 +871,15 @@ class Core:
     # --------------------------------------------------------------- compact
     async def compact(self) -> None:
         """Fold everything, snapshot, write-new-then-delete-old
-        (north-star path, lib.rs:332-380, with both WIP defects fixed)."""
-        await self.read_remote()
+        (north-star path, lib.rs:332-380, with both WIP defects fixed).
+
+        The ingest below runs the overlapped streaming pipeline when the
+        storage/accelerator support it (_read_remote_ops_pipelined /
+        _read_remote_ops_bulk): decrypt+decode of chunk k+1 proceeds
+        while chunk k folds, with per-stage ``stream.*`` trace spans —
+        see docs/streaming_pipeline.md for how to read them."""
+        with trace.span("compact.ingest"):
+            await self.read_remote()
         # sync snapshot section
         d = self._data
         payload = [

@@ -114,7 +114,9 @@ def _bind_state(lib) -> None:
     lib.orset_fresh_fold.restype = ctypes.c_int
     lib.dense_clock_dict.argtypes = [i32p, ctypes.c_int64, ctypes.py_object]
     lib.dense_clock_dict.restype = ctypes.py_object
-    lib.bytes_lens_join.argtypes = [ctypes.py_object, u64p, u8p]
+    lib.bytes_lens_join.argtypes = [
+        ctypes.py_object, u64p, u8p, ctypes.c_int64, ctypes.c_int64
+    ]
     lib.bytes_lens_join.restype = ctypes.c_int64
     lib.canon_pack.argtypes = [ctypes.py_object]
     lib.canon_pack.restype = ctypes.py_object

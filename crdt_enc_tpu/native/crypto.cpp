@@ -143,7 +143,16 @@ static inline v16u rotlv16(v16u x, int n) {
   return (x << n) | (x >> (32 - n));
 }
 
+#if defined(__clang__) || (defined(__GNUC__) && __GNUC__ >= 12)
 #define SHUF16(a, b, ...) __builtin_shufflevector(a, b, __VA_ARGS__)
+#else
+// GCC < 12 has no __builtin_shufflevector; its __builtin_shuffle
+// two-vector form has the same concatenated-index semantics with the
+// indices packed into an integer mask vector.  Same codegen class
+// (vperm*); the AEAD tests cross-check against the `cryptography`
+// wheel, so a semantic slip here cannot pass CI.
+#define SHUF16(a, b, ...) __builtin_shuffle(a, b, (v16u){__VA_ARGS__})
+#endif
 
 static inline void transpose16(v16u x[16]) {
   v16u t[16];

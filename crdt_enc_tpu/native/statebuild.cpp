@@ -434,9 +434,20 @@ PyObject* canon_pack(PyObject* obj) {
 // any element is not exactly ``bytes`` (caller falls back to Python).
 // Replaces a np.fromiter(len, ...) + b"".join() pair that cost ~9ms at
 // the 83k-tiny-blob config-5 shape (round-5 phase profile).
-int64_t bytes_lens_join(PyObject* seq, uint64_t* lens, uint8_t* out) {
+//
+// ``out_capacity`` bounds the join pass and ``expected_n`` bounds BOTH
+// buffers: callers size ``lens`` (and, for the join, ``out``) from an
+// earlier ``len()`` / lengths-only call, and pure Python runs between
+// those and this ctypes call — a list mutated in that window (grown,
+// shrunk, or re-totalled) must return -1 BEFORE any write runs past a
+// buffer, never overrun the heap (ADVICE r5, medium).  The caller must
+// also verify the join's return equals its expected total (a short
+// -1-free join is equally stale) and fall back to Python.
+int64_t bytes_lens_join(PyObject* seq, uint64_t* lens, uint8_t* out,
+                        int64_t out_capacity, int64_t expected_n) {
     if (!PyList_CheckExact(seq)) return -1;
     Py_ssize_t n = PyList_GET_SIZE(seq);
+    if (expected_n >= 0 && n != (Py_ssize_t)expected_n) return -1;
     int64_t total = 0;
     for (Py_ssize_t i = 0; i < n; ++i) {
         PyObject* b = PyList_GET_ITEM(seq, i);
@@ -444,6 +455,7 @@ int64_t bytes_lens_join(PyObject* seq, uint64_t* lens, uint8_t* out) {
         Py_ssize_t ln = PyBytes_GET_SIZE(b);
         lens[i] = (uint64_t)ln;
         if (out) {
+            if (total + (int64_t)ln > out_capacity) return -1;
             memcpy(out + total, PyBytes_AS_STRING(b), (size_t)ln);
         }
         total += (int64_t)ln;

@@ -205,19 +205,57 @@ def lww_fold_pallas(
     )
 
 
-def lww_limbs(ts_hi, ts_lo, actor, num_values: int) -> tuple:
-    """Static per-column limb counts for ``lww_fold_pallas`` from the
-    batch's host-side maxima (upper bounds are fine — extra limbs cost
-    matmuls, missing limbs would corrupt, so bounds only round UP)."""
-    import numpy as np
+# Limb counts are quantized into [1, _LIMB_COUNT_MAX]: the columns are
+# int32 (≤ 31 significant bits), so ceil(31 / _LIMB) limbs always suffice
+# and the (hi, lo, av) static-arg tuple space is provably ≤ 4³ = 64 —
+# varying batch maxima can trigger at most that many Pallas compiles per
+# process, never an unbounded stream of them (ADVICE r5, low;
+# regression-pinned in tests/test_pallas_lww.py).
+_LIMB_COUNT_MAX = -(-31 // 8)  # == 4 at the 8-bit limb width
 
+
+def lww_limbs_from_maxima(m_hi: int, m_lo: int, m_av: int) -> tuple:
+    """(hi, lo, av) limb counts from column maxima, each quantized into
+    ``[1, _LIMB_COUNT_MAX]`` (upper bounds are fine — extra limbs cost
+    matmuls, missing limbs would corrupt, so bounds only round UP).
+
+    A maximum past ``_LIMB_COUNT_MAX`` limbs raises: quantization must
+    bound recompiles, never silently drop high bits — the kernel's
+    int32 contract (and accel.py's rank-product gate) keeps in-repo
+    callers inside the bound."""
     def nl(mx: int) -> int:
-        return max(1, (int(mx).bit_length() + _LIMB - 1) // _LIMB)
+        mx = int(mx)
+        if mx >= 1 << (_LIMB * _LIMB_COUNT_MAX):
+            raise ValueError(
+                f"column maximum {mx} needs more than {_LIMB_COUNT_MAX} "
+                f"{_LIMB}-bit limbs; the Pallas LWW fold is int32-only"
+            )
+        return max(1, min((mx.bit_length() + _LIMB - 1) // _LIMB,
+                          _LIMB_COUNT_MAX))
+
+    return (nl(m_hi), nl(m_lo), nl(m_av))
+
+
+def lww_column_maxima(ts_hi, ts_lo, actor, num_values: int) -> tuple:
+    """The three host-side column maxima ``lww_limbs`` quantizes — one
+    O(N) pass each; callers reusing columns across folds can cache this
+    tuple and go through :func:`lww_limbs_from_maxima` directly."""
+    import numpy as np
 
     m_hi = int(np.max(ts_hi, initial=0))
     m_lo = int(np.max(ts_lo, initial=0))
     m_av = (int(np.max(actor, initial=0)) + 1) * num_values  # ≥ max av+1
-    return (nl(m_hi), nl(m_lo), nl(m_av))
+    return (m_hi, m_lo, m_av)
+
+
+def lww_limbs(ts_hi, ts_lo, actor, num_values: int, maxima=None) -> tuple:
+    """Static per-column limb counts for ``lww_fold_pallas`` from the
+    batch's host-side maxima (``maxima``: a cached
+    :func:`lww_column_maxima` tuple, to skip the three O(N) passes when
+    the columns are reused)."""
+    if maxima is None:
+        maxima = lww_column_maxima(ts_hi, ts_lo, actor, num_values)
+    return lww_limbs_from_maxima(*maxima)
 
 
 @partial(

@@ -1,4 +1,5 @@
-"""Chunked (bounded-memory) folds for op streams larger than device memory.
+"""Chunked (bounded-memory) folds and the overlapped streaming-compaction
+pipeline.
 
 The long-context story (SURVEY.md §2.3): a replica's op log is the
 framework's "sequence", and because the fold is associative the log can be
@@ -8,6 +9,31 @@ batch on device: fixed-size chunks stream through one compiled fold whose
 state planes are **donated** (`jax.jit(donate_argnums=...)`), so XLA reuses
 the plane buffers in place and device memory stays at
 ``one chunk + one set of planes`` regardless of stream length.
+
+**Overlap** (this module's second job): the host-side front end — AEAD
+decrypt, native decode, columnarization, H2D staging — dominates a full
+single-dispatch compaction by ~40× (BASELINE config #5), so the pipeline
+here runs it CONCURRENTLY with the device fold:
+
+* a producer stage (one thread; its decrypt/decode calls are native and
+  release the GIL) ingests chunk k+1 while the consumer folds chunk k
+  (:func:`run_ingest_pipeline`, backpressure-bounded so at most ``depth``
+  chunks of host memory are ever live — default 2, the double buffer);
+* the consumer issues the async ``jax.device_put`` of chunk k+1 BEFORE
+  dispatching the donated fold of chunk k, so the H2D transfer rides
+  under the previous fold's device execution
+  (:func:`fold_chunks_overlapped`);
+* column staging reuses pre-allocated fixed-shape buffers
+  (:class:`ChunkPool`) instead of allocating per chunk — the host buffer
+  for chunk k is recycled the moment its transfer lands.
+
+Every stage is timed through ``utils.trace`` spans (``stream.decrypt``,
+``stream.decode``, ``stream.ingest``, ``stream.h2d``, ``stream.fold``,
+``stream.reduce``, ``stream.d2h``) with the chunk index as span ``meta``,
+so the overlap is auditable from the event log
+(``trace.enable_events()``) — tests/test_streaming_pipeline.py pins that
+chunk k+1's ingest starts before chunk k's fold completes, and
+``bench.py --e2e-streaming`` publishes the per-stage marginals.
 
 Exactness: chunked ≡ whole-batch under the causal-delivery contract the
 core guarantees (per-actor op files apply in version order, core.py
@@ -19,11 +45,14 @@ tests pin the semantics at both extremes.
 
 from __future__ import annotations
 
+import queue as _queue
+import threading
 from functools import partial
 
 import jax
 import numpy as np
 
+from ..utils import trace
 from .orset import orset_fold
 
 
@@ -64,11 +93,92 @@ def _fold_donated_pallas(
     )
 
 
-def iter_orset_chunks(kind, member, actor, counter, chunk_rows: int, num_replicas: int):
+class ChunkPool:
+    """Pre-allocated fixed-shape op-column staging buffers.
+
+    The pipeline's ONLY host staging memory: ``depth`` buffer sets of
+    ``(kind int8, member/actor/counter int32) × chunk_rows``.
+    ``acquire()`` blocks while every set is out — together with the
+    ingest semaphore this is what bounds live host memory to ``depth``
+    chunks however long the stream runs.  Release a set only after its
+    H2D transfer has completed (``fold_chunks_overlapped`` does): on the
+    CPU backend ``jax.device_put`` may alias the host buffer, and on any
+    backend the async copy reads it after the call returns.
+    """
+
+    def __init__(self, chunk_rows: int, depth: int = 2):
+        if depth < 2:
+            # the overlapped consumer holds one buffer in `pending` while
+            # the chunk iterator acquires the next — a single-buffer pool
+            # would deadlock there (and on aliasing backends the pending
+            # buffer cannot be released until its fold completes)
+            raise ValueError(f"ChunkPool needs depth >= 2, got {depth}")
+        self.chunk_rows = chunk_rows
+        self.depth = depth
+        self._free: _queue.Queue = _queue.Queue()
+        for _ in range(depth):
+            self._free.put((
+                np.zeros(chunk_rows, np.int8),
+                np.zeros(chunk_rows, np.int32),
+                np.zeros(chunk_rows, np.int32),
+                np.zeros(chunk_rows, np.int32),
+            ))
+
+    def acquire(self) -> tuple:
+        return self._free.get()
+
+    def release(self, bufs: tuple) -> None:
+        self._free.put(bufs)
+
+
+def columnarize_into(
+    bufs, kind, member, actor, counter, lo: int, hi: int, num_replicas: int
+):
+    """Copy rows ``[lo:hi)`` of the flat columns into a pool buffer set,
+    sentinel-padding the tail (``actor == num_replicas`` rows, which every
+    kernel masks out).  Returns ``bufs``."""
+    k, m, a, c = bufs
+    n = hi - lo
+    np.copyto(k[:n], kind[lo:hi], casting="unsafe")
+    np.copyto(m[:n], member[lo:hi], casting="unsafe")
+    np.copyto(a[:n], actor[lo:hi], casting="unsafe")
+    np.copyto(c[:n], counter[lo:hi], casting="unsafe")
+    if n < len(k):
+        k[n:] = 0
+        m[n:] = 0
+        a[n:] = num_replicas
+        c[n:] = 0
+    return bufs
+
+
+def iter_orset_chunks(
+    kind, member, actor, counter, chunk_rows: int, num_replicas: int,
+    pool: ChunkPool | None = None,
+):
     """Slice flat op columns into fixed-shape chunks (the tail is padded
     with ``actor == num_replicas`` sentinel rows, which every kernel
-    masks out) — one shape ⇒ one compilation for the whole stream."""
+    masks out) — one shape ⇒ one compilation for the whole stream.
+
+    With a ``pool`` the chunks are columnarized into its pre-allocated
+    buffers instead of fresh arrays; the consumer MUST release each
+    buffer set back (``fold_chunks_overlapped(..., pool=pool)`` does)
+    and ``pool.chunk_rows`` must equal ``chunk_rows``."""
     n = len(kind)
+    if pool is not None:
+        assert pool.chunk_rows == chunk_rows, "pool shape mismatch"
+        kind = np.asarray(kind)
+        member = np.asarray(member)
+        actor = np.asarray(actor)
+        counter = np.asarray(counter)
+        for lo in range(0, n, chunk_rows):
+            hi = min(lo + chunk_rows, n)
+            with trace.span("stream.columnarize", meta=lo // chunk_rows):
+                bufs = columnarize_into(
+                    pool.acquire(), kind, member, actor, counter,
+                    lo, hi, num_replicas,
+                )
+            yield bufs
+        return
     for lo in range(0, n, chunk_rows):
         hi = min(lo + chunk_rows, n)
         pad = chunk_rows - (hi - lo)
@@ -84,6 +194,67 @@ def iter_orset_chunks(kind, member, actor, counter, chunk_rows: int, num_replica
         yield k, m, a, c
 
 
+def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None):
+    """The overlapped consumer loop: fold an iterable of host column
+    chunks into device ``planes`` with one-chunk H2D lookahead.
+
+    Per cycle: the async ``jax.device_put`` of chunk k+1 is issued FIRST,
+    then the donated ``fold_step(planes, dev_chunk_k)`` is dispatched
+    (async), then the loop blocks on chunk k+1's transfer — which
+    therefore rides under fold k's device execution — and recycles the
+    host buffer to ``pool``.  ``fold_step`` must donate the planes and
+    may be the jitted folds above or a test double.
+
+    Returns the final device planes (NOT blocked: callers overlap their
+    own epilogue, or block + pull under a ``stream.d2h`` span via
+    :func:`planes_to_host`).
+
+    Buffer recycling: on accelerators the H2D copy is real, so chunk k's
+    staging buffer recycles as soon as its transfer lands (which happens
+    under fold k-1's execution).  On the CPU backend ``jax.device_put``
+    may ALIAS the host buffer zero-copy for the array's whole lifetime —
+    there the buffer is held until the fold that consumes it completes
+    (no overlap lost: host and "device" are the same silicon)."""
+    aliasing = pool is not None and jax.default_backend() == "cpu"
+    pending = None  # device-resident chunk awaiting its fold dispatch
+    pending_host = None  # its staging buffers (aliasing backends only)
+    k = 0
+    for host_chunk in chunks:
+        with trace.span("stream.h2d", meta=k):
+            dev_chunk = tuple(jax.device_put(x) for x in host_chunk)
+        if pending is not None:
+            with trace.span("stream.fold", meta=k - 1):
+                planes = fold_step(planes, pending)
+            if aliasing:
+                # fold k-1 has fully consumed its (possibly aliased)
+                # staging buffers once its output is materialized
+                jax.block_until_ready(planes)
+                pool.release(pending_host)
+        if pool is not None and not aliasing:
+            # block on THIS chunk's transfer (it runs under fold k-1),
+            # then the staging buffer is safely reusable
+            jax.block_until_ready(dev_chunk)
+            pool.release(host_chunk)
+        pending = dev_chunk
+        pending_host = host_chunk
+        k += 1
+    if pending is not None:
+        with trace.span("stream.fold", meta=k - 1):
+            planes = fold_step(planes, pending)
+        if aliasing:
+            jax.block_until_ready(planes)
+            pool.release(pending_host)
+    return planes
+
+
+def planes_to_host(planes):
+    """Block on the in-flight folds and pull the planes to host, under
+    the pipeline's ``stream.d2h`` span."""
+    with trace.span("stream.d2h"):
+        jax.block_until_ready(planes)
+        return tuple(np.asarray(x) for x in planes)
+
+
 def orset_fold_stream(
     clock0,
     add0,
@@ -95,6 +266,8 @@ def orset_fold_stream(
     impl: str = "fused",
     small_counters: bool = False,
     tile_cap: int | None = None,
+    h2d_lookahead: bool = True,
+    pool: ChunkPool | None = None,
 ):
     """Fold an iterable of fixed-shape op chunks into the state planes.
 
@@ -102,6 +275,11 @@ def orset_fold_stream(
     common row count (see :func:`iter_orset_chunks`).  Returns the folded
     ``(clock, add, rm)`` device arrays.  The planes are donated between
     chunks — do not reuse the input arrays after calling.
+
+    ``h2d_lookahead`` (default on) runs the overlapped consumer loop:
+    chunk k+1's transfer is issued while chunk k's fold is in flight
+    (:func:`fold_chunks_overlapped`); pass ``pool`` when the chunk
+    iterator stages into a :class:`ChunkPool` so buffers recycle.
 
     ``impl="pallas"`` runs each chunk through the MXU fold
     (ops/pallas_fold.py); pass ``tile_cap`` computed over the WHOLE
@@ -121,17 +299,100 @@ def orset_fold_stream(
                 "member column)"
             )
         interpret = jax.default_backend() != "tpu"
-        for kind, member, actor, counter in chunks:
-            clock, add, rm = _fold_donated_pallas(
-                clock, add, rm, kind, member, actor, counter,
+
+        def fold_step(planes, chunk):
+            return _fold_donated_pallas(
+                *planes, *chunk,
                 num_members=num_members, num_replicas=num_replicas,
                 tile_cap=tile_cap, interpret=interpret,
             )
-        return clock, add, rm
-    for kind, member, actor, counter in chunks:
-        clock, add, rm = _fold_donated(
-            clock, add, rm, kind, member, actor, counter,
-            num_members=num_members, num_replicas=num_replicas,
-            impl=impl, small_counters=small_counters,
+    else:
+        def fold_step(planes, chunk):
+            return _fold_donated(
+                *planes, *chunk,
+                num_members=num_members, num_replicas=num_replicas,
+                impl=impl, small_counters=small_counters,
+            )
+
+    if h2d_lookahead:
+        return fold_chunks_overlapped(
+            (clock, add, rm), chunks, fold_step, pool=pool
         )
-    return clock, add, rm
+    planes = (clock, add, rm)
+    for chunk in chunks:
+        planes = fold_step(planes, chunk)
+        if pool is not None:
+            jax.block_until_ready(planes)
+            pool.release(chunk)
+    return planes
+
+
+class PipelineError(Exception):
+    """A producer-stage failure, re-raised in the consumer with the
+    original exception as ``__cause__``."""
+
+
+def run_ingest_pipeline(spans, ingest_fn, reduce_fn, *, depth: int = 2):
+    """Two-stage overlapped pipeline over ``spans`` (any sequence of work
+    items, e.g. encrypted-blob slices).
+
+    A producer thread runs ``ingest_fn(span, k)`` — decrypt + decode;
+    host work whose native calls release the GIL — for chunk k+1 while
+    the calling thread runs ``reduce_fn(ingested, k)`` — columnarize +
+    fold — on chunk k.
+
+    Backpressure: a ``BoundedSemaphore(depth)`` is acquired BEFORE chunk
+    ingest starts and released only after its reduce completes, so at
+    most ``depth`` chunks are ever live host-side (default 2: the double
+    buffer — one being ingested, one being reduced).
+
+    Stage timing: ingest runs under a ``stream.ingest`` span and reduce
+    under ``stream.reduce``, both with ``meta=k`` — with
+    ``trace.enable_events()`` the event log shows ingest k+1 starting
+    before reduce k ends, which is the overlap proof the seam test pins.
+
+    Errors: a producer exception surfaces here as :class:`PipelineError`
+    (original as ``__cause__``); a consumer exception stops the producer
+    at its next semaphore acquire and re-raises unchanged.
+    """
+    slots = threading.BoundedSemaphore(depth)
+    out_q: _queue.Queue = _queue.Queue()
+    stop = threading.Event()
+
+    def produce():
+        try:
+            for k, span in enumerate(spans):
+                # backpressure: wait for a live-chunk slot (poll so a dead
+                # consumer can't strand this thread forever)
+                while not slots.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    slots.release()
+                    return
+                with trace.span("stream.ingest", meta=k):
+                    item = ingest_fn(span, k)
+                out_q.put(("chunk", k, item))
+            out_q.put(("end", None, None))
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            out_q.put(("error", None, e))
+
+    producer = threading.Thread(
+        target=produce, name="crdt-ingest-producer", daemon=True
+    )
+    producer.start()
+    try:
+        while True:
+            tag, k, item = out_q.get()
+            if tag == "end":
+                return
+            if tag == "error":
+                raise PipelineError("ingest producer failed") from item
+            try:
+                with trace.span("stream.reduce", meta=k):
+                    reduce_fn(item, k)
+            finally:
+                slots.release()
+    finally:
+        stop.set()
+        producer.join(timeout=30.0)

@@ -158,19 +158,24 @@ class TpuAccelerator(HostAccelerator):
                 # dense path does (chunk size == MAX_ROWS, so the row
                 # bound holds by construction here).
                 from ..ops import pallas_fold as PF
+                from ..ops.stream import ChunkPool
 
                 stream_kw = {}
                 if self._pallas_eligible(counter):
                     stream_kw = dict(
                         impl="pallas", tile_cap=PF.fold_cap(member, E)
                     )
+                # double-buffered staging: chunk k+1 columnarizes into a
+                # recycled pool buffer and its H2D transfer rides under
+                # chunk k's fold (ops/stream.py fold_chunks_overlapped)
+                pool = ChunkPool(self.STREAM_CHUNK_ROWS, depth=2)
                 clock, add, rm = K.orset_fold_stream(
                     clock0, add0, rm0,
                     K.iter_orset_chunks(
                         kind, member, actor, counter,
-                        self.STREAM_CHUNK_ROWS, R,
+                        self.STREAM_CHUNK_ROWS, R, pool=pool,
                     ),
-                    num_members=E, num_replicas=R, **stream_kw,
+                    num_members=E, num_replicas=R, pool=pool, **stream_kw,
                 )
             else:
                 cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
@@ -395,6 +400,84 @@ class TpuAccelerator(HostAccelerator):
         if decoded is None:
             return False
         return self._fold_orset_decoded(state, decoded, actors_sorted)
+
+    def fold_encrypted_stream(
+        self, state, key: bytes, blobs: list, *, actors_hint=(),
+        chunk_blobs: int = 0, n_chunks: int = 8, depth: int = 2,
+        n_threads: int = 0,
+    ) -> bool:
+        """The full overlapped streaming-compaction front end (BASELINE
+        config #5 shape): encrypted op-file blobs in → folded ``state``
+        out, with the host stages running CONCURRENTLY with the fold.
+
+        A producer thread runs threaded native decrypt
+        (``decrypt_blobs_packed``) + native columnar decode for chunk
+        k+1 while this thread columnarizes and folds chunk k through a
+        fold session (parallel/session.py — BUFFER / HOST_REDUCE /
+        DEVICE_STREAM by regime; the device mode issues chunk H2D under
+        the in-flight donated fold).  Backpressure bounds live host
+        memory to ``depth`` chunks (ops/stream.py
+        ``run_ingest_pipeline``).  Per-stage trace spans
+        (``stream.decrypt`` / ``stream.decode`` / ``stream.ingest`` /
+        ``stream.reduce`` / ``stream.finish``) make the overlap
+        auditable; ``bench.py --e2e-streaming`` publishes them.
+
+        Returns False — with ``state`` untouched (sessions mutate only
+        at finish) — when no session exists for this CRDT type or the
+        native decoder declines; the caller replays its own copy of the
+        blobs down another path.  Crypto failures (AeadError) and
+        pipeline faults raise.
+        """
+        from ..backends.xchacha import decrypt_blobs, decrypt_blobs_packed
+        from ..ops.stream import run_ingest_pipeline
+        from .session import SessionDeclined
+
+        session = self.open_fold_session(state, actors_hint=actors_hint)
+        if session is None:
+            return False
+        n = len(blobs)
+        if n == 0:
+            return True
+        if chunk_blobs <= 0:
+            chunk_blobs = max(1, -(-n // max(n_chunks, 1)))
+        spans = [blobs[i : i + chunk_blobs] for i in range(0, n, chunk_blobs)]
+
+        accepts_packed = getattr(session, "accepts_packed", False)
+
+        def ingest(span, k):
+            with trace.span("stream.decrypt", meta=k):
+                payloads = decrypt_blobs_packed(key, span, n_threads)
+                if payloads is None:
+                    payloads = decrypt_blobs(key, span, n_threads)
+                elif not accepts_packed:
+                    # span-decoder-less sessions (counters, maps) take
+                    # per-blob views of the shared cleartext buffer
+                    out, offs = payloads
+                    view = memoryview(out)
+                    lo_hi = offs.tolist()
+                    payloads = [
+                        view[int(lo_hi[i]) : int(lo_hi[i + 1])]
+                        for i in range(len(lo_hi) - 1)
+                    ]
+            with trace.span("stream.decode", meta=k):
+                # thread-safe by contract: decode_chunk never mutates
+                # the session (parallel/session.py)
+                return session.decode_chunk(payloads)
+
+        def reduce(decoded, k):
+            session.reduce_chunk(decoded)
+
+        try:
+            run_ingest_pipeline(spans, ingest, reduce, depth=depth)
+            with trace.span("stream.finish"):
+                session.finish()
+        except SessionDeclined:
+            return False
+        except K.PipelineError as e:
+            if isinstance(e.__cause__, SessionDeclined):
+                return False
+            raise e.__cause__ from None
+        return True
 
     def fold_payload_stream(self, state, chunks, actors_hint=()) -> bool:
         """ORSet bulk front end over an *iterator* of decrypted-payload
@@ -761,16 +844,25 @@ class TpuAccelerator(HostAccelerator):
             num_values = V if len(cols.actors_sorted) * V < 2**31 else None
             if self._lww_pallas_eligible(num_values, hi, len(key_col)):
                 from ..ops.pallas_lww import (
-                    lww_fold_pallas, lww_limbs, lww_tile_cap,
+                    lww_column_maxima, lww_fold_pallas, lww_limbs,
+                    lww_tile_cap,
                 )
 
+                # maxima on the UNPADDED columns, computed once (the pad
+                # rows are zeros and cannot raise them); the limb counts
+                # are quantized to their 1-4 range, so varying batches
+                # draw from ≤ 64 static tuples — recompiles stay bounded
+                maxima = lww_column_maxima(
+                    cols.ts_hi, cols.ts_lo, cols.actor, num_values
+                )
                 m_hi, m_lo, m_actor, m_value, present = lww_fold_pallas(
                     key_col, hi, lo, actor_col, value_col,
                     num_keys=Kn, num_values=num_values,
                     tile_cap=lww_tile_cap(key_col, Kn),
                     # static limb counts from the batch's host-side maxima:
                     # the in-kernel per-chunk limb conds measured 4x slower
-                    limbs=lww_limbs(hi, lo, actor_col, num_values),
+                    limbs=lww_limbs(hi, lo, actor_col, num_values,
+                                    maxima=maxima),
                 )
             else:
                 m_hi, m_lo, m_actor, m_value, present = K.lww_fold(

@@ -28,6 +28,20 @@ from .. import ops as K
 from ..ops.columnar import KIND_ADD, KIND_RM
 from ..ops.counters import sum_wide
 
+# jax < 0.5 ships shard_map under experimental only, with the replication
+# check named check_rep instead of check_vma; this module-local shim (the
+# only shard_map entry point in the repo) translates — without patching
+# the jax namespace, which other libraries feature-detect.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_sm
+
+    def _shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _experimental_sm(f, **kw)
+
 
 def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
     """A (dp, mp) mesh over the available devices; defaults to all devices
@@ -155,7 +169,7 @@ def orset_fold_sharded(
     member_lo = np.arange(mp, dtype=np.int32) * E_local
 
     # op rows sharded over dp; plane member-axis sharded over mp
-    fold = jax.shard_map(
+    fold = _shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -178,7 +192,7 @@ def orset_merge_sharded(mesh: Mesh, clock_a, add_a, rm_a, clock_b, add_b, rm_b):
     """Pairwise state merge with planes sharded over mp — pure elementwise,
     so the spec is trivial; exists to keep compaction fully SPMD."""
 
-    merge = jax.shard_map(
+    merge = _shard_map(
         K.orset_merge,
         mesh=mesh,
         in_specs=(P(), P("mp", None), P("mp", None), P(), P("mp", None), P("mp", None)),
@@ -247,7 +261,7 @@ def pncounter_fold_sharded(mesh: Mesh, p0, n0, sign, actor, counter):
         n = jnp.maximum(n0, jax.lax.pmax(n, "dp"))
         return p, n, sum_wide(p) - sum_wide(n)
 
-    fold = jax.shard_map(
+    fold = _shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(), P("dp"), P("dp"), P("dp")),
@@ -294,7 +308,7 @@ def lww_fold_sharded(mesh: Mesh, key, ts_hi, ts_lo, actor, value, *, num_keys: i
             acc = K.lww_table_merge(tuple(x[i] for x in g), acc)
         return acc
 
-    fold = jax.shard_map(
+    fold = _shard_map(
         body,
         mesh=mesh,
         in_specs=(P("dp"),) * 5,
@@ -341,7 +355,7 @@ def crdtmap_scatter_sharded(
         )
 
     n_rows = 3 + 4 + 4 + 5
-    fold = jax.shard_map(
+    fold = _shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P()) + (P("dp"),) * n_rows,
@@ -376,7 +390,7 @@ def mvreg_keep_sharded(mesh: Mesh, clocks, valid):
         dominated = jnp.any((ge & gt) & full_v[:, None], axis=0)
         return v_slice & ~dominated
 
-    keep = jax.shard_map(
+    keep = _shard_map(
         body,
         mesh=mesh,
         in_specs=(P("dp", None), P("dp")),

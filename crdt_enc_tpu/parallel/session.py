@@ -94,6 +94,11 @@ class OrsetFoldSession:
     ``finish()`` exactly once — only finish mutates ``state``.
     """
 
+    # decode_chunk accepts the packed ``(buffer, offsets)`` cleartext pair
+    # straight from decrypt_blobs_packed (the zero-object-materialization
+    # shape); sessions without span decoders take per-blob payload lists
+    accepts_packed = True
+
     def __init__(self, accel, state: ORSet, actors_hint=()):
         self.accel = accel
         self.state = state
@@ -118,6 +123,12 @@ class OrsetFoldSession:
         self._buffered: list[tuple] = []
         self._buffered_bytes = 0
         self._member_canon: dict[int, bytes] = {}
+        # actor-table flattening + native hash index, built once per
+        # session and reused across chunk decodes (rebuilding per chunk
+        # at 100k actors costs more than the decode itself); entries are
+        # immutable, so concurrent decode_chunk threads can share it —
+        # a racing double-build just writes the same value twice
+        self._decode_cache: dict = {}
         self.rows_fed = 0
         # HOST_REDUCE accumulators (allocated at promotion)
         self._h_add = self._h_rm = None
@@ -131,12 +142,17 @@ class OrsetFoldSession:
         """Stage 1, thread-safe (no session mutation): native columnar
         decode of one chunk's payloads.  The ctypes call releases the GIL,
         so the core decodes chunk i+1 while chunk i reduces."""
-        from ..ops.native_decode import decode_orset_payload_batch
+        from ..ops.native_decode import (
+            combine_orset_spans, decode_orset_payload_spans,
+        )
 
         with trace.span("session.decode"):
-            decoded = decode_orset_payload_batch(payloads, self.actors_sorted)
-        if decoded is None:
-            raise SessionDeclined("native decoder declined the chunk")
+            part = decode_orset_payload_spans(
+                payloads, self.actors_sorted, cache=self._decode_cache
+            )
+            if part is None:
+                raise SessionDeclined("native decoder declined the chunk")
+            decoded = combine_orset_spans([part])
         return decoded
 
     def reduce_chunk(self, decoded) -> None:
@@ -333,7 +349,8 @@ class OrsetFoldSession:
 
         from ..ops import pallas_fold as PF
         from ..ops.stream import (
-            _fold_donated, _fold_donated_pallas, iter_orset_chunks,
+            _fold_donated, _fold_donated_pallas, fold_chunks_overlapped,
+            iter_orset_chunks,
         )
 
         if len(self.members) > self._d_E:
@@ -352,30 +369,36 @@ class OrsetFoldSession:
             use_pallas = FORCE_PALLAS_STREAM
             interpret = jax.default_backend() != "tpu"
         tile_cap = PF.fold_cap(member, self._d_E) if use_pallas else 0
+
+        # retire_rm=False: a horizon retired against the batch-local
+        # clock would lose its kill-effect on pre-existing state
+        # entries; finish() retires once against the true merged clock
+        def fold_step(planes, chunk):
+            if use_pallas:
+                return _fold_donated_pallas(
+                    *planes, *chunk,
+                    num_members=self._d_E, num_replicas=self.R,
+                    tile_cap=tile_cap, retire_rm=False,
+                    interpret=interpret,
+                )
+            return _fold_donated(
+                *planes, *chunk,
+                num_members=self._d_E, num_replicas=self.R,
+                impl="fused", small_counters=False, retire_rm=False,
+            )
+
         with trace.span("session.device_fold"):
             rows = min(DEVICE_CHUNK_ROWS, _bucket(len(kind)))
-            clock, add, rm = self._d_planes
-            for chunk in iter_orset_chunks(kind, member, actor, counter, rows, self.R):
-                # retire_rm=False: a horizon retired against the
-                # batch-local clock would lose its kill-effect on
-                # pre-existing state entries; finish() retires once
-                # against the true merged clock
-                if use_pallas:
-                    clock, add, rm = _fold_donated_pallas(
-                        clock, add, rm, *chunk,
-                        num_members=self._d_E, num_replicas=self.R,
-                        tile_cap=tile_cap, retire_rm=False,
-                        interpret=interpret,
-                    )
-                else:
-                    clock, add, rm = _fold_donated(
-                        clock, add, rm, *chunk,
-                        num_members=self._d_E, num_replicas=self.R,
-                        impl="fused", small_counters=False, retire_rm=False,
-                    )
-            # no block: jax dispatch is async — the next chunk's decrypt
-            # and decode overlap the device work
-            self._d_planes = (clock, add, rm)
+            # overlapped consumer loop: chunk k+1's H2D transfer is
+            # issued while chunk k's donated fold is in flight; the
+            # final fold stays un-blocked — jax dispatch is async, so
+            # the next chunk's decrypt and decode overlap the device
+            # work (ops/stream.py fold_chunks_overlapped)
+            self._d_planes = fold_chunks_overlapped(
+                self._d_planes,
+                iter_orset_chunks(kind, member, actor, counter, rows, self.R),
+                fold_step,
+            )
 
     # ---------------------------------------------------------------- finish
     def finish(self) -> ORSet:

@@ -25,6 +25,15 @@ Usage::
 
 Logging: spans emit DEBUG records on the ``crdt_enc_tpu.trace`` logger;
 enable with ``logging.getLogger("crdt_enc_tpu").setLevel(logging.DEBUG)``.
+
+Event log: aggregated (count, seconds) slots cannot show *when* phases ran
+relative to each other, which is exactly what auditing an overlapped
+pipeline needs (did chunk k+1's ingest start before chunk k's fold
+finished?).  ``enable_events()`` turns on a per-occurrence log — every span
+exit also appends ``{"name", "t0", "t1", "meta"}`` with monotonic
+``perf_counter`` timestamps comparable across threads — read it back with
+``events()``.  Off by default (spans fire at batch granularity, but callers
+like the streaming seam tests want zero surprise cost elsewhere).
 """
 
 from __future__ import annotations
@@ -44,12 +53,30 @@ jax_annotations = False
 _lock = threading.Lock()
 _spans: dict[str, list] = {}  # name -> [count, total_seconds]
 _counters: dict[str, int] = {}
+_events_enabled = False
+_events: list[dict] = []  # per-occurrence: {name, t0, t1, meta}
+
+
+def enable_events(on: bool = True) -> None:
+    """Toggle the per-occurrence event log (see module docs)."""
+    global _events_enabled
+    with _lock:
+        _events_enabled = on
+
+
+def events() -> list[dict]:
+    """A consistent copy of the recorded span occurrences, in completion
+    order.  Each entry: name, t0, t1 (``time.perf_counter`` seconds —
+    monotonic, cross-thread comparable), meta (the span's ``meta`` arg)."""
+    with _lock:
+        return [dict(e) for e in _events]
 
 
 @contextmanager
-def span(name: str):
+def span(name: str, meta=None):
     """Time a phase.  Re-entrant and concurrency-tolerant: every exit
-    accumulates (count, seconds) under ``name``."""
+    accumulates (count, seconds) under ``name``.  ``meta`` (e.g. a chunk
+    index) is recorded only in the event log, never in the aggregate."""
     ann = None
     if jax_annotations and "jax" in sys.modules:
         import jax.profiler
@@ -60,13 +87,16 @@ def span(name: str):
     try:
         yield
     finally:
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         if ann is not None:
             ann.__exit__(None, None, None)
         with _lock:
             slot = _spans.setdefault(name, [0, 0.0])
             slot[0] += 1
             slot[1] += dt
+            if _events_enabled:
+                _events.append({"name": name, "t0": t0, "t1": t1, "meta": meta})
         logger.debug("span %s: %.6fs", name, dt)
 
 
@@ -92,6 +122,7 @@ def reset() -> None:
     with _lock:
         _spans.clear()
         _counters.clear()
+        _events.clear()
 
 
 def report() -> str:

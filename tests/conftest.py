@@ -36,7 +36,15 @@ if "jax" in sys.modules:
 # ---- collection bookkeeping for the PARITY.md test-count assertion ----
 # (tests/test_parity_count.py): the documented count kept drifting from
 # the real one (VERDICT r4 weak item 5), so it is now asserted in CI.
+# The dict is stashed on the pytest config (pytest_configure below) and
+# read through the ``request`` fixture — never imported from here, so the
+# suite survives --import-mode=importlib / src-layout changes where
+# ``import conftest`` does not resolve (ADVICE r5, low).
 COLLECT_INFO = {"n_items": None, "n_files": None, "n_deselected": 0}
+
+
+def pytest_configure(config):
+    config.crdt_collect_info = COLLECT_INFO
 
 
 def pytest_deselected(items):

@@ -209,7 +209,7 @@ def test_sparse_fold_property_random_histories():
     """Hypothesis sweep: sparse ≡ host from arbitrary base states and op
     tails (the fixed-seed tests above pin a handful of histories; this
     pins the space)."""
-    from hypothesis import given, settings, strategies as st
+    from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
     script = st.lists(
         st.tuples(

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import msgpack
 import pytest
-from hypothesis import given, settings, strategies as st
+from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
 from crdt_enc_tpu.utils import codec
 

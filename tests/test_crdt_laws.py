@@ -15,7 +15,7 @@ adversarial (chosen by hypothesis).
 
 import uuid
 
-from hypothesis import given, settings, strategies as st
+from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
 from crdt_enc_tpu.models import (
     GCounter,

@@ -14,7 +14,7 @@ import copy
 import uuid
 
 import pytest
-from hypothesis import given, settings, strategies as st
+from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
 from crdt_enc_tpu.backends import (
     IdentityCryptor,

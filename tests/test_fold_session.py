@@ -465,3 +465,32 @@ def test_session_keeps_untouched_preexisting_members(force_mode):
     )
     assert folded.contains("old-untouched"), force_mode
     assert canonical_bytes(folded) == canonical_bytes(host), force_mode
+
+
+def test_encrypted_stream_device_mode_matches_host(monkeypatch):
+    """ISSUE 1 differential: the full overlapped pipeline (threaded
+    decrypt + decode producer → session consumer) forced through the
+    DEVICE_STREAM donated-fold mode lands byte-identical to the per-op
+    host loop — streaming ≡ whole-batch on the device path too."""
+    import secrets
+
+    import crdt_enc_tpu.parallel.session as S
+    from crdt_enc_tpu import native
+    from crdt_enc_tpu.backends import xchacha
+
+    try:
+        native.load()
+    except RuntimeError as e:
+        pytest.skip(f"native crypto library unavailable: {e}")
+    monkeypatch.setattr(S, "BUFFER_BYTES", 0)  # promote on first chunk
+    monkeypatch.setattr(S, "HOST_PLANE_CELLS", -1)  # ... to device planes
+    host, ops = _history(300, 17, seed=6)
+    key = secrets.token_bytes(32)
+    blobs = [xchacha.encrypt_blob(key, p) for p in _payloads(ops)]
+    accel = TpuAccelerator(min_device_batch=1)
+    streamed = ORSet()
+    ok = accel.fold_encrypted_stream(
+        streamed, key, blobs, actors_hint=ACTORS, n_chunks=5
+    )
+    assert ok
+    assert canonical_bytes(streamed) == canonical_bytes(host)

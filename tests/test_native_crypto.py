@@ -45,6 +45,7 @@ def _ietf_decrypt(key, nonce, aad, ct):
 
 
 def test_ietf_matches_cryptography_wheel():
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
     # sizes straddle the 8-block SIMD lane boundary (512 bytes): the lane
@@ -64,6 +65,7 @@ def test_ietf_matches_cryptography_wheel():
 
 
 def test_ietf_empty_plaintext_and_aad():
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
     key, nonce = secrets.token_bytes(32), secrets.token_bytes(12)
@@ -109,6 +111,7 @@ def _hchacha_ours(key: bytes, nonce16: bytes) -> bytes:
 
 
 def test_hchacha20_draft_vector():
+    pytest.importorskip("cryptography")
     # draft-irtf-cfrg-xchacha §2.2.1 inputs; expectation pinned against the
     # independent oracle above (which also validates the oracle derivation:
     # the first 16 output bytes are the draft's well-known 82413b42… prefix)
@@ -122,6 +125,7 @@ def test_hchacha20_draft_vector():
 
 
 def test_hchacha20_randomized_vs_oracle():
+    pytest.importorskip("cryptography")
     for _ in range(10):
         key, nonce = secrets.token_bytes(32), secrets.token_bytes(16)
         assert _hchacha_ours(key, nonce) == _hchacha_oracle(key, nonce)
@@ -204,3 +208,84 @@ def test_batch_decrypt():
     for i, pt in enumerate(pts):
         start = int(out_offsets[i])
         assert out[start : start + len(pt)].tobytes() == pt
+
+
+# ---- wheel-free oracle -----------------------------------------------------
+# The tests above need the `cryptography` wheel; boxes without it still
+# must not ship an unvalidated SIMD keystream (the 8/16-lane transpose
+# paths are exactly where a compiler/builtin-shim slip would hide, and a
+# symmetric permutation error survives roundtrip tests).  This oracle is
+# ~40 lines of pure Python — slow, unconditional, independent.
+
+
+def _chacha_block_py(key: bytes, counter: int, nonce: bytes) -> bytes:
+    import struct
+
+    def rotl(x, n):
+        return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+    def qr(s, a, b, c, d):
+        s[a] = (s[a] + s[b]) & 0xFFFFFFFF; s[d] = rotl(s[d] ^ s[a], 16)
+        s[c] = (s[c] + s[d]) & 0xFFFFFFFF; s[b] = rotl(s[b] ^ s[c], 12)
+        s[a] = (s[a] + s[b]) & 0xFFFFFFFF; s[d] = rotl(s[d] ^ s[a], 8)
+        s[c] = (s[c] + s[d]) & 0xFFFFFFFF; s[b] = rotl(s[b] ^ s[c], 7)
+
+    st = (
+        list(struct.unpack("<4I", b"expand 32-byte k"))
+        + list(struct.unpack("<8I", key))
+        + [counter]
+        + list(struct.unpack("<3I", nonce))
+    )
+    w = st[:]
+    for _ in range(10):
+        qr(w, 0, 4, 8, 12); qr(w, 1, 5, 9, 13)
+        qr(w, 2, 6, 10, 14); qr(w, 3, 7, 11, 15)
+        qr(w, 0, 5, 10, 15); qr(w, 1, 6, 11, 12)
+        qr(w, 2, 7, 8, 13); qr(w, 3, 4, 9, 14)
+    return struct.pack(
+        "<16I", *((a + b) & 0xFFFFFFFF for a, b in zip(w, st))
+    )
+
+
+def _poly1305_py(otk: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(otk[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(otk[16:], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        acc = (acc + int.from_bytes(msg[i : i + 16] + b"\x01", "little")) * r % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _aead_py(key: bytes, nonce: bytes, aad: bytes, pt: bytes) -> bytes:
+    ct = bytes(
+        x ^ y
+        for i in range(0, len(pt), 64)
+        for x, y in zip(
+            pt[i : i + 64], _chacha_block_py(key, 1 + i // 64, nonce)
+        )
+    )
+    otk = _chacha_block_py(key, 0, nonce)[:32]
+
+    def pad16(b):
+        return b + bytes(-len(b) % 16)
+
+    mac_data = (
+        pad16(aad) + pad16(ct)
+        + len(aad).to_bytes(8, "little") + len(ct).to_bytes(8, "little")
+    )
+    return ct + _poly1305_py(otk, mac_data)
+
+
+def test_ietf_matches_pure_python_reference():
+    """Wheel-free AEAD oracle across sizes straddling the scalar, 8-lane
+    (512B groups) and 16-lane (1KB groups) keystream paths."""
+    sizes = [0, 1, 63, 64, 300, 511, 512, 513, 1024, 2048, 4096, 8192]
+    for trial, size in enumerate(sizes):
+        key = secrets.token_bytes(32)
+        nonce = secrets.token_bytes(12)
+        aad = secrets.token_bytes(trial % 5 * 7)
+        pt = secrets.token_bytes(size)
+        oracle = _aead_py(key, nonce, aad, pt)
+        assert _ietf_encrypt(key, nonce, aad, pt) == oracle, size
+        assert _ietf_decrypt(key, nonce, aad, oracle) == pt, size

@@ -14,6 +14,7 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+from crdt_enc_tpu import native
 from crdt_enc_tpu.models import ORSet
 from crdt_enc_tpu.models.vclock import VClock
 from crdt_enc_tpu.ops import columnar as C
@@ -137,3 +138,73 @@ def test_int64_counter_falls_back():
     # the merged clock must not wrap through an int32 narrowing (this
     # silently corrupted before round 4 — clock.astype(np.int32))
     assert r.clock.get(b"a0") == 2 ** 40
+
+
+def test_bytes_lens_join_capacity_bound():
+    """ADVICE r5 (medium) regression: the join pass is bounded by
+    ``out_capacity`` — a blobs list that grew between the lengths pass
+    and the join pass (pure Python runs between the two ctypes calls)
+    returns -1 BEFORE writing past the buffer, and a clean join returns
+    exactly the expected total so callers can detect staleness."""
+    from crdt_enc_tpu import native
+
+    try:
+        slib = native.load_state()
+    except RuntimeError as e:
+        pytest.skip(f"native state library unavailable: {e}")
+    blobs = [b"abc", b"defg", b"hi"]
+    n = len(blobs)
+    lens = np.zeros(n, np.uint64)
+    total = int(slib.bytes_lens_join(
+        blobs, lens.ctypes.data_as(native.u64p), None, 0, n
+    ))
+    assert total == 9 and lens.tolist() == [3, 4, 2]
+    # join with exactly-sized capacity succeeds and fills the buffer
+    out = np.zeros(total, np.uint8)
+    assert int(slib.bytes_lens_join(
+        blobs, lens.ctypes.data_as(native.u64p),
+        out.ctypes.data_as(native.u8p), total, n,
+    )) == total
+    assert out.tobytes() == b"abcdefghi"
+    # a list that GREW after sizing: rejected by the element-count bound
+    # BEFORE any lens[] write (the lens array was sized for n) — and even
+    # with the count unchecked (expected_n=-1) the join stops at the
+    # capacity and reports -1, leaving the canary past the buffer's
+    # logical end untouched
+    blobs.append(b"overflow-blob")
+    lens2 = np.zeros(len(blobs), np.uint64)
+    guard = np.full(total + 1, 0xAB, np.uint8)
+    assert int(slib.bytes_lens_join(
+        blobs, lens2.ctypes.data_as(native.u64p),
+        guard.ctypes.data_as(native.u8p), total, n,
+    )) == -1
+    assert int(slib.bytes_lens_join(
+        blobs, lens2.ctypes.data_as(native.u64p),
+        guard.ctypes.data_as(native.u8p), total, -1,
+    )) == -1
+    assert guard[total] == 0xAB
+    # non-bytes element: -1 without touching the output
+    assert int(slib.bytes_lens_join(
+        [b"x", 7], lens2.ctypes.data_as(native.u64p), None, 0, 2
+    )) == -1
+
+
+def test_decrypt_blobs_packed_survives_blob_list_mutation():
+    """End-to-end pin of the hardened join path: the bulk decrypt's
+    lengths-pass → capacity-bounded join → verified-total sequence
+    roundtrips correctly (the mutation fallback itself is pinned at the
+    native layer in test_bytes_lens_join_capacity_bound — list mutation
+    between the two passes cannot be scripted deterministically from
+    here, but the -1/short-return it produces is)."""
+    import secrets
+
+    from crdt_enc_tpu.backends import xchacha
+
+    try:
+        native.load()
+    except RuntimeError as e:
+        pytest.skip(f"native crypto library unavailable: {e}")
+    key = secrets.token_bytes(32)
+    blobs = [xchacha.encrypt_blob(key, b"v%d" % i) for i in range(24)]
+    out = xchacha.decrypt_blobs(key, blobs)
+    assert [bytes(v) for v in out] == [b"v%d" % i for i in range(24)]

@@ -9,7 +9,7 @@ in bench.py.
 import uuid
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
 from crdt_enc_tpu.models import (
     GCounter,
@@ -518,7 +518,7 @@ def test_counter_sorted_vs_scatter_paths():
 
 
 def test_counter_sorted_hypothesis():
-    from hypothesis import given, settings, strategies as st
+    from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
     import numpy as np
 

@@ -159,7 +159,7 @@ def test_parity_large_R_actor_blocks(R):
 
 # ---- property sweep ------------------------------------------------------
 
-from hypothesis import given, settings, strategies as st
+from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
 
 @settings(max_examples=40, deadline=None)

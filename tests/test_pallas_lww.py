@@ -107,7 +107,7 @@ def test_parity_large_ts_hi_limbs():
     _run_both(key, hi, lo, actor, value, K, V)
 
 
-from hypothesis import given, settings, strategies as st
+from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
 
 @settings(max_examples=25, deadline=None)
@@ -128,3 +128,40 @@ def test_parity_select_window_mode():
     straddle both windows."""
     _run_both(*_gen(1200, 20000, 30, 50, seed=9), 20000, 50,
               win_mode="select")
+
+
+def test_limb_counts_quantized_and_bounded():
+    """ADVICE r5 regression: the (hi, lo, av) limb tuple is a jit static
+    arg of the Pallas LWW fold, so across arbitrarily varied batches the
+    tuple space — and hence the compile count — must stay bounded.
+    Quantization pins every component into [1, 4]; 200 randomized
+    batches (including pathological maxima) may produce at most 64
+    distinct tuples."""
+    from crdt_enc_tpu.ops.pallas_lww import (
+        _LIMB_COUNT_MAX, lww_column_maxima, lww_limbs,
+        lww_limbs_from_maxima,
+    )
+
+    assert _LIMB_COUNT_MAX == 4
+    rng = np.random.default_rng(0)
+    seen = set()
+    for trial in range(200):
+        n = int(rng.integers(1, 50))
+        hi = rng.integers(0, 2 ** 31 - 1, n).astype(np.int64)
+        lo = rng.integers(0, 2 ** 31 - 1, n).astype(np.int64)
+        actor = rng.integers(0, 2 ** 20, n).astype(np.int64)
+        v = int(rng.integers(1, 1000))
+        limbs = lww_limbs(hi, lo, actor, v)
+        assert all(1 <= c <= 4 for c in limbs), limbs
+        # the maxima round-trip matches the direct computation (callers
+        # reusing columns cache the maxima and skip the O(N) passes)
+        assert limbs == lww_limbs_from_maxima(
+            *lww_column_maxima(hi, lo, actor, v)
+        )
+        seen.add(limbs)
+    # empty columns stay inside the quantized range; a maximum past the
+    # int32 contract RAISES rather than silently dropping high bits
+    assert lww_limbs(np.zeros(0), np.zeros(0), np.zeros(0), 1) == (1, 1, 1)
+    with pytest.raises(ValueError, match="limbs"):
+        lww_limbs_from_maxima(2 ** 62, 1, 1)
+    assert len(seen) <= 64

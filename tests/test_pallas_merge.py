@@ -4,8 +4,7 @@ interpreter mode — semantics only; the bandwidth win is a TPU property."""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from _hyp import given, settings, st  # hypothesis, or skip-stubs
 
 from crdt_enc_tpu import ops as K
 from crdt_enc_tpu.models import ORSet, canonical_bytes

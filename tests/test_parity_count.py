@@ -14,8 +14,6 @@ against the ``test_*.py`` files on disk.
 import pathlib
 import re
 
-from conftest import COLLECT_INFO
-
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PARITY = ROOT / "docs" / "PARITY.md"
 COUNT_RE = re.compile(r"`tests/` — (\d+) tests")
@@ -27,8 +25,13 @@ def parity_count() -> int:
     return int(m.group(1))
 
 
-def test_parity_count_matches_collection():
+def test_parity_count_matches_collection(request):
     import pytest
+
+    # collection info travels on the pytest config (conftest stashes it in
+    # pytest_configure) — importing conftest directly would break under
+    # --import-mode=importlib (ADVICE r5)
+    COLLECT_INFO = request.config.crdt_collect_info
 
     n_disk_files = len(list((ROOT / "tests").glob("test_*.py")))
     if COLLECT_INFO["n_files"] != n_disk_files:

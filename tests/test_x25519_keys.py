@@ -8,6 +8,11 @@ import asyncio
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="x25519_keys backend needs the cryptography wheel",
+)
+
 from crdt_enc_tpu.backends import FsStorage, XChaChaCryptor
 from crdt_enc_tpu.backends.x25519_keys import (
     NotARecipient,
