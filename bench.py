@@ -403,17 +403,23 @@ def e2e_streaming(smoke: bool):
     log(f"overlapped ≡ sequential (full batch): {full_batch_equal}")
 
     t_seq = min(_timed_host(sequential) for _ in range(ITERS))
-    # per-stage marginals from the LAST overlapped pass's trace spans
+    # per-stage marginals + the full obs snapshot (stage histograms with
+    # p50/p95/p99, recompile + transfer counters, device-memory gauges)
+    # from the BEST overlapped pass's trace spans.  The accelerator wired
+    # jax_compiles tracking at construction (obs.runtime); a non-zero
+    # count on a post-warmup pass is the ADVICE-r5 recompile bug class.
     t_ovl = float("inf")
     stage_marginals = {}
+    obs_snapshot = {}
     for _ in range(ITERS):
         trace.reset()
         t = _timed_host(overlapped)
         if t < t_ovl:
             t_ovl = t
+            obs_snapshot = trace.snapshot()
             stage_marginals = {
                 name: round(v["seconds"], 4)
-                for name, v in trace.snapshot()["spans"].items()
+                for name, v in obs_snapshot["spans"].items()
                 if name.startswith(("stream.", "session."))
             }
     trace.reset()
@@ -453,6 +459,11 @@ def e2e_streaming(smoke: bool):
         "shape": {"N": N, "R": R, "E": E, "ops_per_file": OPF,
                   "files": len(payloads), "n_chunks": N_CHUNKS,
                   "total_ops": total_ops},
+        # full registry snapshot of the best pass: per-stage histograms
+        # (p50/p95/p99/max), jax_compiles / h2d_bytes counters, device
+        # memory gauges — render with
+        # `python -m crdt_enc_tpu.tools.obs_report report BENCH_LOCAL.jsonl`
+        "obs": obs_snapshot,
     })
 
 

@@ -905,6 +905,24 @@ class Core:
         d.read_states.add(name)
         # local ops are now folded into the snapshot; reset the producer
         # cursor bookkeeping is unnecessary — versions only grow.
+        # run-scoped metrics sink (CRDT_OBS_SINK / obs.sink.configure):
+        # every compaction appends its phase table + counters, so the
+        # streaming pipeline is auditable after the process is gone.
+        # Off the event loop: with events enabled the record can carry a
+        # full ring of timeline events, and json.dumps + the file append
+        # must not stall concurrent ingests (the registry is lock-backed,
+        # so snapshot/drain from a worker thread is safe).
+        from ..obs import sink as obs_sink
+
+        if obs_sink.default_sink() is not None:
+            # ops_to_remove is (actor, covered-version-cursor) pairs —
+            # the GC prefix per actor, not a file count
+            await asyncio.to_thread(
+                obs_sink.maybe_write,
+                "compact",
+                {"gc_op_actors": len(ops_to_remove),
+                 "gc_states": len(states_to_remove)},
+            )
 
     # ------------------------------------------------- remote meta lifecycle
     async def _read_remote_meta(self, force_notify: bool = False) -> None:

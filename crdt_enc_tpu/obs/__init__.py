@@ -1,0 +1,28 @@
+"""First-class observability subsystem (ISSUE 2).
+
+The reference ships zero observability (SURVEY.md §5); the rebuild's
+BASELINE claims — pipeline overlap, byte-identical convergence, the
+compaction speedup — are invisible without instrumentation.  This package
+is the full layer on top of the span/counter registry PR 1 seeded:
+
+* :mod:`.record`  — the process-wide registry: spans with bounded
+  log-scale latency histograms (p50/p95/p99), counters, gauges, and a
+  bounded per-occurrence event ring buffer with thread identity.
+  ``crdt_enc_tpu.utils.trace`` is a compat shim onto this module.
+* :mod:`.timeline` — Chrome-trace/Perfetto JSON export of the event log
+  (per-thread lanes, chunk-index args, counter tracks) plus the chunk
+  overlap analysis the streaming-pipeline acceptance tests assert on.
+* :mod:`.runtime`  — JAX runtime signals: XLA recompile counting via
+  ``jax.monitoring``, H2D transfer accounting, device memory gauges
+  sampled at fold boundaries.
+* :mod:`.sink`     — run-scoped JSONL metrics sink (``CRDT_OBS_SINK``)
+  and Prometheus-style text exposition.
+
+CLI: ``python -m crdt_enc_tpu.tools.obs_report`` renders phase tables,
+exports timelines, and diffs runs.  Span/metric names are registered in
+``docs/observability.md`` and linted by ``tools/check_span_names.py``.
+"""
+
+from . import record, runtime, sink, timeline
+
+__all__ = ["record", "runtime", "sink", "timeline"]

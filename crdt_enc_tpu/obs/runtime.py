@@ -1,0 +1,132 @@
+"""JAX runtime signals: recompiles, H2D transfers, device memory.
+
+The regressions ADVICE r5 caught by hand — an unbounded-recompile fold
+loop, a silent fallback off the device path — are exactly the ones this
+module makes mechanical:
+
+* **Recompile counter** (:func:`track_recompiles`): every XLA backend
+  compile bumps the ``jax_compiles`` counter and records its duration
+  under the ``jax.compile`` span, via the public ``jax.monitoring``
+  duration-event stream.  A steady-state fold loop whose ``jax_compiles``
+  grows per iteration is recompiling — the bucket-padding contract is
+  broken (tests/test_obs.py pins the counter constant across a
+  varying-batch fold loop).
+* **H2D accounting**: the streaming paths count ``h2d_bytes`` at each
+  ``jax.device_put`` issue (ops/stream.py, parallel/session.py); transfer
+  issue latency is the ``stream.h2d`` span histogram.
+* **Device memory** (:func:`sample_device_memory`): ``bytes_in_use`` /
+  ``peak_bytes_in_use`` gauges sampled at fold boundaries — the
+  bounded-device-memory claim of the donated-plane streaming fold,
+  observable.  A no-op on backends without allocator stats (CPU), probed
+  once and cached.
+
+Nothing here imports jax at module load: the registry stays importable in
+jax-less tooling contexts, and the listeners attach only when asked.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import record
+
+_lock = threading.Lock()
+_listener_installed = False
+_recompiles_enabled = False
+_recompiles_explicit = False  # an operator choice must stick
+
+# The one duration event XLA emits exactly once per backend compilation
+# (jaxpr tracing and MLIR lowering emit siblings; counting those would
+# double-book a single cache miss).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_mem_supported: bool | None = None  # probed once; None = not yet probed
+
+
+def _on_duration_event(event: str, duration: float, **kwargs) -> None:
+    if _recompiles_enabled and event == _COMPILE_EVENT:
+        record.add("jax_compiles", 1)
+        record.observe("jax.compile", duration)
+
+
+def track_recompiles(on: bool = True) -> None:
+    """Start (or stop) counting XLA backend compiles into the
+    ``jax_compiles`` counter / ``jax.compile`` span.  Idempotent; the
+    monitoring listener registers once per process and toggles via a
+    flag (jax.monitoring offers no unregister).  Counts are process-wide
+    and cleared by ``trace.reset()`` like every other counter.  An
+    explicit call here is an OPERATOR choice — the accelerator's
+    default-on wiring (:func:`ensure_recompile_tracking`) never
+    overrides it."""
+    global _recompiles_explicit
+    with _lock:
+        _recompiles_explicit = True
+    _set_recompiles(on)
+
+
+def ensure_recompile_tracking() -> None:
+    """Default-on wiring (TpuAccelerator.__init__): enable tracking
+    unless the operator already made an explicit track_recompiles()
+    choice — constructing a second accelerator must not silently undo a
+    deliberate opt-out."""
+    with _lock:
+        if _recompiles_explicit:
+            return
+    _set_recompiles(True)
+
+
+def _set_recompiles(on: bool) -> None:
+    global _listener_installed, _recompiles_enabled
+    with _lock:
+        _recompiles_enabled = on
+        if on and not _listener_installed:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_duration_event
+            )
+            _listener_installed = True
+
+
+def recompile_count() -> int:
+    """The current ``jax_compiles`` counter (0 when tracking is off)."""
+    return record.snapshot()["counters"].get("jax_compiles", 0)
+
+
+def sample_device_memory(device=None) -> dict | None:
+    """Record ``device_bytes_in_use`` / ``device_peak_bytes`` gauges from
+    the backend allocator, returning the raw stats dict.  Returns None —
+    and stays cheap, a cached boolean check — on backends without
+    allocator stats (the CPU backend) or before jax is imported.
+
+    The capability cache applies only to the DEFAULT device: an
+    explicitly passed ``device`` is always probed (a stats-less default
+    backend must not disable sampling of a capable one), and a transient
+    exception never latches the cache — only a successful probe that
+    reports no stats does."""
+    global _mem_supported
+    default_dev = device is None
+    if default_dev and _mem_supported is False:
+        return None
+    import sys
+
+    if "jax" not in sys.modules:
+        return None
+    import jax
+
+    try:
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None  # transient failure: do not latch the capability
+    if not stats:
+        if default_dev:
+            _mem_supported = False
+        return None
+    if default_dev:
+        _mem_supported = True
+    if "bytes_in_use" in stats:
+        record.gauge("device_bytes_in_use", int(stats["bytes_in_use"]))
+    if "peak_bytes_in_use" in stats:
+        record.gauge("device_peak_bytes", int(stats["peak_bytes_in_use"]))
+    return stats

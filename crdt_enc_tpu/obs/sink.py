@@ -1,0 +1,129 @@
+"""Run-scoped metrics sink: JSONL records + Prometheus text exposition.
+
+A compaction's phase table dies with the process unless something writes
+it down.  The sink appends ONE self-contained JSON line per labelled
+snapshot — the same append-only, crash-tolerant shape as
+``BENCH_LOCAL.jsonl`` — so a service operator (or the bench harness) can
+diff runs, export timelines, and graph metrics after the fact:
+
+    {"label": "compact", "ts": <unix seconds>, "spans": {...},
+     "counters": {...}, "gauges": {...}, "events": [...]?, "meta": {...}?}
+
+``events`` is attached only when the event log is enabled and non-empty
+(timelines are opt-in; aggregates are always cheap), and the ring buffer
+is drained per write — each record carries its own run's timeline.
+
+Wiring: set ``CRDT_OBS_SINK=/path/run.jsonl`` and every ``Core.compact``
+(and every ``tools/fsck --obs`` run) appends a snapshot automatically
+(:func:`maybe_write`);
+``bench.py --e2e-streaming`` embeds the same snapshot shape in its
+BENCH_LOCAL record; :func:`configure` sets the sink programmatically.
+``python -m crdt_enc_tpu.tools.obs_report`` consumes the files.
+
+:func:`to_prometheus` renders a snapshot in the Prometheus text format
+(counters as ``_total``, span totals/quantiles and gauges as gauges) for
+scrape endpoints or textfile collectors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from . import record
+
+ENV_VAR = "CRDT_OBS_SINK"
+
+_configured: "MetricsSink | None | bool" = False  # False = not resolved yet
+
+
+class MetricsSink:
+    """Append-only JSONL sink for labelled registry snapshots."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, label: str, *, snapshot: dict | None = None,
+              events: list | None = None, meta: dict | None = None) -> dict:
+        """Append one record; returns it.  ``snapshot`` defaults to the
+        live registry.  ``events`` defaults to DRAINING the live event
+        log when recording is enabled — each record carries only the
+        timeline since the previous write, so a long-lived service with
+        events on does not re-serialize a growing (up to ring-capacity)
+        log into every record.  Never raises on I/O failure —
+        bookkeeping must not kill a good run (same contract as
+        BENCH_LOCAL.jsonl)."""
+        snap = record.snapshot() if snapshot is None else snapshot
+        rec = {"label": label, "ts": round(time.time(), 3), **snap}
+        if events is None:
+            evs = record.drain_events() if record.events_enabled() else []
+        else:
+            evs = events
+        if evs:
+            rec["events"] = evs
+        if meta:
+            rec["meta"] = meta
+        try:
+            line = json.dumps(rec)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except (OSError, TypeError, ValueError):
+            pass
+        return rec
+
+
+def configure(path: str | None) -> "MetricsSink | None":
+    """Set (or with None, clear) the process-default sink, overriding the
+    ``CRDT_OBS_SINK`` environment variable."""
+    global _configured
+    _configured = MetricsSink(path) if path else None
+    return _configured
+
+
+def default_sink() -> "MetricsSink | None":
+    """The configured sink, else one from ``CRDT_OBS_SINK``, else None.
+    The env var is re-read per call so tests (and long-lived services
+    re-exec'd with new env) see changes."""
+    if _configured is not False:
+        return _configured
+    path = os.environ.get(ENV_VAR)
+    return MetricsSink(path) if path else None
+
+
+def maybe_write(label: str, meta: dict | None = None) -> dict | None:
+    """Append a snapshot to the default sink if one is configured —
+    the zero-cost-when-unconfigured hook Core.compact and the tools
+    call."""
+    sink = default_sink()
+    if sink is None:
+        return None
+    return sink.write(label, meta=meta)
+
+
+def to_prometheus(snap: dict | None = None, prefix: str = "crdt") -> str:
+    """Render one snapshot in the Prometheus text exposition format."""
+    if snap is None:
+        snap = record.snapshot()
+    lines = [
+        f"# TYPE {prefix}_span_seconds_total counter",
+        f"# TYPE {prefix}_span_count_total counter",
+        f"# TYPE {prefix}_counter_total counter",
+        f"# TYPE {prefix}_gauge gauge",
+    ]
+    for name, v in sorted(snap.get("spans", {}).items()):
+        lab = f'{{span="{name}"}}'
+        lines.append(f"{prefix}_span_seconds_total{lab} {v['seconds']:.6f}")
+        lines.append(f"{prefix}_span_count_total{lab} {v['count']}")
+        for q in ("p50", "p95", "p99"):
+            ms = v.get(f"{q}_ms")
+            if ms is not None:
+                lines.append(
+                    f'{prefix}_span_seconds{{span="{name}",quantile='
+                    f'"0.{q[1:]}"}} {ms / 1e3:.6f}'
+                )
+    for name, v in sorted(snap.get("counters", {}).items()):
+        lines.append(f'{prefix}_counter_total{{name="{name}"}} {v}')
+    for name, v in sorted(snap.get("gauges", {}).items()):
+        lines.append(f'{prefix}_gauge{{name="{name}"}} {v}')
+    return "\n".join(lines) + "\n"

@@ -52,6 +52,7 @@ from functools import partial
 import jax
 import numpy as np
 
+from ..obs import runtime as obs_runtime
 from ..utils import trace
 from .orset import orset_fold
 
@@ -221,6 +222,10 @@ def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None):
     k = 0
     for host_chunk in chunks:
         with trace.span("stream.h2d", meta=k):
+            trace.add(
+                "h2d_bytes",
+                sum(getattr(x, "nbytes", 0) for x in host_chunk),
+            )
             dev_chunk = tuple(jax.device_put(x) for x in host_chunk)
         if pending is not None:
             with trace.span("stream.fold", meta=k - 1):
@@ -244,6 +249,9 @@ def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None):
         if aliasing:
             jax.block_until_ready(planes)
             pool.release(pending_host)
+    # fold boundary: the bounded-device-memory claim (one chunk + donated
+    # planes), observable — a no-op on backends without allocator stats
+    obs_runtime.sample_device_memory()
     return planes
 
 
@@ -286,9 +294,13 @@ def orset_fold_stream(
     member column (``fold_cap``) so every chunk compiles once — a
     per-chunk cap is bounded by the global one.
     """
-    clock = jax.device_put(np.asarray(clock0, np.int32))
-    add = jax.device_put(np.asarray(add0, np.int32))
-    rm = jax.device_put(np.asarray(rm0, np.int32))
+    clock0 = np.asarray(clock0, np.int32)
+    add0 = np.asarray(add0, np.int32)
+    rm0 = np.asarray(rm0, np.int32)
+    trace.add("h2d_bytes", clock0.nbytes + add0.nbytes + rm0.nbytes)
+    clock = jax.device_put(clock0)
+    add = jax.device_put(add0)
+    rm = jax.device_put(rm0)
     if impl == "pallas":
         if tile_cap is None:
             # a per-chunk fold_cap here would recompile the donated fold

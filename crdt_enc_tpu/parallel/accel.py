@@ -19,6 +19,7 @@ from ..core.adapters import HostAccelerator
 from ..models import GCounter, LWWMap, ORSet, PNCounter
 from ..models.counters import NEG, POS
 from ..models.vclock import Dot, VClock
+from ..obs import runtime as obs_runtime
 from ..utils import trace
 from .. import ops as K
 
@@ -53,6 +54,11 @@ class TpuAccelerator(HostAccelerator):
     ):
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # every XLA backend compile around the jitted/Pallas folds bumps
+        # the jax_compiles counter — steady-state growth is the ADVICE-r5
+        # unbounded-recompile bug class, now mechanically visible
+        # (default-on; an explicit operator track_recompiles(False) wins)
+        obs_runtime.ensure_recompile_tracking()
         # CrdtMap scatter phase: "host" (numpy reference), "device"
         # (ops/map_device.py jit), or None = device for batches past
         # min_device_batch
@@ -193,6 +199,7 @@ class TpuAccelerator(HostAccelerator):
             clock, add, rm = (
                 np.asarray(clock), np.asarray(add), np.asarray(rm),
             )
+        obs_runtime.sample_device_memory()  # fold boundary
         with trace.span("fold.writeback"):
             folded = K.orset_planes_to_state(clock, add, rm, members, replicas)
         state.clock = folded.clock

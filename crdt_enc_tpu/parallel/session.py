@@ -43,6 +43,7 @@ import numpy as np
 from .. import ops as K
 from ..models import GCounter, ORSet, PNCounter
 from ..models.counters import POS
+from ..obs import runtime as obs_runtime
 from ..ops.columnar import KIND_ADD, KIND_RM
 from ..utils import trace
 
@@ -239,6 +240,7 @@ class OrsetFoldSession:
             # the event loop (core drain_one → to_thread)
             import jax
 
+            trace.add("h2d_bytes", 4 * (self.R + 2 * self._d_E * self.R))
             self._d_planes = (
                 jax.device_put(np.zeros(max(self.R, 1), np.int32)),
                 jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
@@ -448,6 +450,7 @@ class OrsetFoldSession:
                     clock0, add0, rm0, add_b, rm_b
                 )
         else:
+            obs_runtime.sample_device_memory()  # planes still resident
             with trace.span("session.device_finish"):
                 # op-APPLY semantics, exactly as HOST_REDUCE: the streamed
                 # planes are a fold of OPS from a zero clock, NOT a valid

@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 from ..backends.memory import content_name
 from ..core.core import RemoteMeta
 from ..core.key_cryptor import Keys
-from ..utils import VersionBytes, codec
+from ..utils import VersionBytes, codec, trace
 from ..utils.versions import SUPPORTED_CONTAINER_VERSIONS
 
 
@@ -94,8 +94,9 @@ async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> Fs
 
     # ---- meta family -----------------------------------------------------
     meta = RemoteMeta()
-    names = await storage.list_remote_meta_names()
-    loaded = dict(await storage.load_remote_metas(names))
+    with trace.span("fsck.meta"):
+        names = await storage.list_remote_meta_names()
+        loaded = dict(await storage.load_remote_metas(names))
     for name in names:
         raw = loaded.get(name)
         if raw is None:
@@ -135,62 +136,70 @@ async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> Fs
         return clear_obj
 
     # ---- states ----------------------------------------------------------
-    names = await storage.list_state_names()
-    loaded = dict(await storage.load_states(names))
-    for name in names:
-        raw = loaded.get(name)
-        if raw is None:
-            report.add("warn", "states", name, "listed but unreadable (racing GC?)")
-            continue
-        report.state_files += 1
-        if content_name(raw) != name:
-            report.add("error", "states", name, "content does not match its address")
-            continue
-        if not deep:
-            continue
-        try:
-            obj = await open_sealed(raw)
-            if not (isinstance(obj, (list, tuple)) and len(obj) == 2):
-                raise ValueError("state wrapper is not [state, cursor]")
-        except Exception as e:
-            report.add("error", "states", name, f"{e}")
+    with trace.span("fsck.states"):
+        names = await storage.list_state_names()
+        loaded = dict(await storage.load_states(names))
+        for name in names:
+            raw = loaded.get(name)
+            if raw is None:
+                report.add(
+                    "warn", "states", name, "listed but unreadable (racing GC?)"
+                )
+                continue
+            report.state_files += 1
+            if content_name(raw) != name:
+                report.add(
+                    "error", "states", name, "content does not match its address"
+                )
+                continue
+            if not deep:
+                continue
+            try:
+                obj = await open_sealed(raw)
+                if not (isinstance(obj, (list, tuple)) and len(obj) == 2):
+                    raise ValueError("state wrapper is not [state, cursor]")
+            except Exception as e:
+                report.add("error", "states", name, f"{e}")
 
     # ---- op logs ---------------------------------------------------------
-    actors = await storage.list_op_actors()
-    report.op_actors = len(actors)
-    for actor in actors:
-        hexa = actor.hex()
-        versions = await _list_op_versions(storage, actor)
-        if versions is None:
-            report.add(
-                "warn", "ops", hexa,
-                "storage backend cannot enumerate op versions; "
-                "gap detection skipped",
-            )
+    with trace.span("fsck.ops"):
+        actors = await storage.list_op_actors()
+        report.op_actors = len(actors)
+        for actor in actors:
+            hexa = actor.hex()
+            versions = await _list_op_versions(storage, actor)
+            if versions is None:
+                report.add(
+                    "warn", "ops", hexa,
+                    "storage backend cannot enumerate op versions; "
+                    "gap detection skipped",
+                )
+                if deep:
+                    files = await storage.load_ops([(actor, 1)])
+                    report.op_files += len(files)
+                    await _deep_check_ops(report, open_sealed, hexa, files)
+                continue
+            report.op_files += len(versions)
+            if not versions:
+                continue
+            # dense from the FLOOR — compaction legitimately GCs a prefix,
+            # so a log starting at N+1 is healthy; only holes with files
+            # beyond them strand data (every consumer's scan stops at the
+            # hole)
+            floor = versions[0]
+            expected = set(range(floor, floor + len(versions)))
+            missing = sorted(expected - set(versions))
+            if missing:
+                report.add(
+                    "error", "ops", hexa,
+                    f"gap at version {missing[0]}: "
+                    f"{sum(1 for v in versions if v > missing[0])} file(s) "
+                    "beyond it are unreachable by the dense scan",
+                )
             if deep:
-                files = await storage.load_ops([(actor, 1)])
-                report.op_files += len(files)
+                files = await storage.load_ops([(actor, floor)])
                 await _deep_check_ops(report, open_sealed, hexa, files)
-            continue
-        report.op_files += len(versions)
-        if not versions:
-            continue
-        # dense from the FLOOR — compaction legitimately GCs a prefix, so
-        # a log starting at N+1 is healthy; only holes with files beyond
-        # them strand data (every consumer's scan stops at the hole)
-        floor = versions[0]
-        expected = set(range(floor, floor + len(versions)))
-        missing = sorted(expected - set(versions))
-        if missing:
-            report.add(
-                "error", "ops", hexa,
-                f"gap at version {missing[0]}: "
-                f"{sum(1 for v in versions if v > missing[0])} file(s) "
-                "beyond it are unreachable by the dense scan",
-            )
-        if deep:
-            files = await storage.load_ops([(actor, floor)])
-            await _deep_check_ops(report, open_sealed, hexa, files)
+    trace.add("fsck_ops_decoded", report.ops_decoded)
     if not latest_ok and (
         report.meta_files or report.keys_found
         or report.state_files or report.op_files
@@ -246,6 +255,9 @@ def main(argv=None) -> int:
     ap.add_argument("--shallow", action="store_true",
                     help="skip decrypt/auth; structure and names only")
     ap.add_argument("--passphrase", help="passphrase-sealed key metadata")
+    ap.add_argument("--obs", action="store_true",
+                    help="print the fsck phase table (and append a "
+                    "snapshot to CRDT_OBS_SINK if set)")
     args = ap.parse_args(argv)
 
     from ..backends import (
@@ -269,6 +281,13 @@ def main(argv=None) -> int:
         for issue in report.issues:
             print(issue)
         print(report.summary())
+        if args.obs:
+            import sys
+
+            from ..obs import sink as obs_sink
+
+            print(trace.report(), file=sys.stderr)
+            obs_sink.maybe_write("fsck", meta={"remote": args.remote})
         return 0 if report.ok else 1
 
     return asyncio.run(go())

@@ -1,0 +1,212 @@
+"""Observability CLI: phase tables, timeline export, run diffs.
+
+Consumes the JSONL files the metrics sink writes (``obs.sink``, env
+``CRDT_OBS_SINK``) and the obs snapshots embedded in
+``BENCH_LOCAL.jsonl`` records::
+
+    python -m crdt_enc_tpu.tools.obs_report report RUN.jsonl
+    python -m crdt_enc_tpu.tools.obs_report export-trace RUN.jsonl \\
+        -o trace.json [--check-overlap stream.ingest:stream.reduce]
+    python -m crdt_enc_tpu.tools.obs_report diff OLD.jsonl NEW.jsonl
+    python -m crdt_enc_tpu.tools.obs_report prom RUN.jsonl
+
+* **report** — the per-phase table (totals, counts, p50/p95/p99/max)
+  plus counters and gauges for one record.
+* **export-trace** — Chrome-trace/Perfetto JSON from a record's event
+  log (per-thread lanes, chunk args, counter tracks); with
+  ``--check-overlap A:B`` the exit code asserts chunk k+1's stage A
+  overlapped chunk k's stage B — the streaming pipeline's overlap proof,
+  mechanized (exit 1 when the recorded run was serialized).
+* **diff** — phase-by-phase seconds/count/quantile deltas between two
+  runs (regression triage: which stage got slower, by how much).
+* **prom** — the record in Prometheus text exposition format.
+
+Record selection: ``--label`` filters by snapshot label, ``--index``
+picks among matches (default -1, the newest).  Records without the
+requested field (e.g. no ``events`` for export-trace) are reported as
+such, exit 2.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..obs import record as obs_record
+from ..obs import sink as obs_sink
+from ..obs import timeline as obs_timeline
+
+
+def load_records(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue  # truncated final append from a killed run
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def pick_record(records: list[dict], label: str | None, index: int) -> dict:
+    """One record by label filter + index; the embedded ``obs`` dict of a
+    bench record is hoisted so BENCH_LOCAL.jsonl works directly."""
+    if label is not None:
+        records = [r for r in records if r.get("label") == label]
+    if not records:
+        raise SystemExit(f"no matching records (label={label!r})")
+    try:
+        rec = records[index]
+    except IndexError:
+        raise SystemExit(
+            f"index {index} out of range ({len(records)} matching records)"
+        ) from None
+    if "spans" not in rec and isinstance(rec.get("obs"), dict):
+        rec = {**rec["obs"], "label": rec.get("metric", "bench")}
+    return rec
+
+
+def _fmt_label(rec: dict) -> str:
+    lab = rec.get("label", "?")
+    ts = rec.get("ts")
+    return f"{lab} @ {ts}" if ts else str(lab)
+
+
+def cmd_report(args) -> int:
+    rec = pick_record(load_records(args.file), args.label, args.index)
+    print(f"# {_fmt_label(rec)}")
+    print(obs_record.format_snapshot(rec))
+    return 0
+
+
+def cmd_prom(args) -> int:
+    rec = pick_record(load_records(args.file), args.label, args.index)
+    sys.stdout.write(obs_sink.to_prometheus(rec))
+    return 0
+
+
+def cmd_export_trace(args) -> int:
+    rec = pick_record(load_records(args.file), args.label, args.index)
+    events = rec.get("events")
+    if not events:
+        print(
+            "record has no event log (run with trace.enable_events() / "
+            "CRDT_OBS_SINK and events on)",
+            file=sys.stderr,
+        )
+        return 2
+    trace_obj = obs_timeline.export_chrome_trace(args.output, events)
+    n = len(trace_obj["traceEvents"])
+    print(f"wrote {n} trace events to {args.output}")
+    if args.check_overlap:
+        earlier, _, later = args.check_overlap.partition(":")
+        ks = obs_timeline.chunk_overlaps(trace_obj, earlier, later or earlier)
+        if not ks:
+            print(
+                f"NO overlap: no chunk's {earlier} started before the "
+                f"previous chunk's {later} finished",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"overlap proof: chunk k+1 {earlier} started inside chunk k "
+            f"{later} for k in {ks}"
+        )
+    return 0
+
+
+def cmd_diff(args) -> int:
+    a = pick_record(load_records(args.old), args.label, args.index)
+    b = pick_record(load_records(args.new), args.label, args.index)
+    print(f"# old: {_fmt_label(a)}\n# new: {_fmt_label(b)}")
+    names = sorted(set(a.get("spans", {})) | set(b.get("spans", {})))
+    if names:
+        w = max(len(n) for n in names)
+        print(
+            f"{'span':<{w}}  {'old s':>10}  {'new s':>10}  {'Δ%':>8}"
+            f"  {'count':>11}  {'p99 ms':>17}"
+        )
+        for n in names:
+            sa = a.get("spans", {}).get(n, {})
+            sb = b.get("spans", {}).get(n, {})
+            va, vb = sa.get("seconds", 0.0), sb.get("seconds", 0.0)
+            pct = f"{100.0 * (vb - va) / va:+.1f}%" if va else "new"
+            cnt = f"{sa.get('count', 0)}->{sb.get('count', 0)}"
+            p99 = (
+                f"{sa.get('p99_ms', 0.0):.3f}->{sb.get('p99_ms', 0.0):.3f}"
+            )
+            print(
+                f"{n:<{w}}  {va:>10.4f}  {vb:>10.4f}  {pct:>8}"
+                f"  {cnt:>11}  {p99:>17}"
+            )
+    cnames = sorted(set(a.get("counters", {})) | set(b.get("counters", {})))
+    for n in cnames:
+        va = a.get("counters", {}).get(n, 0)
+        vb = b.get("counters", {}).get(n, 0)
+        if va != vb:
+            print(f"{n} = {va} -> {vb} ({vb - va:+d})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="crdt_enc_tpu.tools.obs_report",
+        description=__doc__.splitlines()[0],
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--label", help="filter records by snapshot label")
+        p.add_argument(
+            "--index", type=int, default=-1,
+            help="which matching record (default -1, the newest)",
+        )
+
+    p = sub.add_parser("report", help="per-phase table for one record")
+    p.add_argument("file")
+    common(p)
+    p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser(
+        "export-trace", help="Chrome-trace/Perfetto JSON from a record"
+    )
+    p.add_argument("file")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument(
+        "--check-overlap", metavar="EARLIER:LATER",
+        help="exit 1 unless chunk k+1's EARLIER span overlaps chunk k's "
+        "LATER span (e.g. stream.ingest:stream.reduce)",
+    )
+    common(p)
+    p.set_defaults(fn=cmd_export_trace)
+
+    p = sub.add_parser("diff", help="phase deltas between two runs")
+    p.add_argument("old")
+    p.add_argument("new")
+    common(p)
+    p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("prom", help="Prometheus text exposition")
+    p.add_argument("file")
+    common(p)
+    p.set_defaults(fn=cmd_prom)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    try:
+        code = main()
+    except BrokenPipeError:  # e.g. `obs_report report … | head`
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    raise SystemExit(code)

@@ -1,153 +1,17 @@
-"""Structured per-phase tracing and counters.
+"""Compat shim: the tracing core was promoted into the first-class
+observability subsystem at :mod:`crdt_enc_tpu.obs.record` (ISSUE 2) —
+timelines live in ``obs.timeline``, JAX runtime signals in
+``obs.runtime``, the metrics sink in ``obs.sink``.
 
-The reference ships no observability at all (SURVEY.md §5: no tracing/log
-crates anywhere; anyhow context strings are the only diagnostics).  The
-rebuild's contract is per-phase timers around the compaction pipeline —
-list/load/decrypt/decode/fold/write — plus counters for the BASELINE
-metric (ops merged/sec), with optional ``jax.profiler`` trace annotations
-so device-side kernel time lines up with host phases in a profile.
-
-Design: one process-wide registry, monotonic wall-clock spans, plain
-dicts under a lock (spans fire at file/batch granularity — hundreds per
-compaction — so overhead is irrelevant next to I/O and crypto).  Spans
-nest; a span records under its own flat name, so concurrent asyncio tasks
-timing the same phase simply accumulate.
-
-Usage::
-
-    from crdt_enc_tpu.utils import trace
-
-    with trace.span("ops.decrypt"):
-        ...
-    trace.add("ops_folded", len(batch))
-    print(trace.report())     # human-readable table
-    trace.snapshot()          # {"spans": {...}, "counters": {...}}
-
-Logging: spans emit DEBUG records on the ``crdt_enc_tpu.trace`` logger;
-enable with ``logging.getLogger("crdt_enc_tpu").setLevel(logging.DEBUG)``.
-
-Event log: aggregated (count, seconds) slots cannot show *when* phases ran
-relative to each other, which is exactly what auditing an overlapped
-pipeline needs (did chunk k+1's ingest start before chunk k's fold
-finished?).  ``enable_events()`` turns on a per-occurrence log — every span
-exit also appends ``{"name", "t0", "t1", "meta"}`` with monotonic
-``perf_counter`` timestamps comparable across threads — read it back with
-``events()``.  Off by default (spans fire at batch granularity, but callers
-like the streaming seam tests want zero surprise cost elsewhere).
+Every existing import site (``from crdt_enc_tpu.utils import trace``)
+keeps working unchanged: this module replaces itself in ``sys.modules``
+with the real registry module, so module-level state — including the
+``trace.jax_annotations`` flag — is THE one registry, not a copy (a
+re-export shim would silently fork mutable flags set through this name).
 """
 
-from __future__ import annotations
-
-import logging
 import sys
-import threading
-import time
-from contextlib import contextmanager
 
-logger = logging.getLogger("crdt_enc_tpu.trace")
+from ..obs import record as _record
 
-# When True and jax is already imported, spans also open a
-# jax.profiler.TraceAnnotation so they show up in device traces.
-jax_annotations = False
-
-_lock = threading.Lock()
-_spans: dict[str, list] = {}  # name -> [count, total_seconds]
-_counters: dict[str, int] = {}
-_events_enabled = False
-_events: list[dict] = []  # per-occurrence: {name, t0, t1, meta}
-
-
-def enable_events(on: bool = True) -> None:
-    """Toggle the per-occurrence event log (see module docs)."""
-    global _events_enabled
-    with _lock:
-        _events_enabled = on
-
-
-def events() -> list[dict]:
-    """A consistent copy of the recorded span occurrences, in completion
-    order.  Each entry: name, t0, t1 (``time.perf_counter`` seconds —
-    monotonic, cross-thread comparable), meta (the span's ``meta`` arg)."""
-    with _lock:
-        return [dict(e) for e in _events]
-
-
-@contextmanager
-def span(name: str, meta=None):
-    """Time a phase.  Re-entrant and concurrency-tolerant: every exit
-    accumulates (count, seconds) under ``name``.  ``meta`` (e.g. a chunk
-    index) is recorded only in the event log, never in the aggregate."""
-    ann = None
-    if jax_annotations and "jax" in sys.modules:
-        import jax.profiler
-
-        ann = jax.profiler.TraceAnnotation(name)
-        ann.__enter__()
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        t1 = time.perf_counter()
-        dt = t1 - t0
-        if ann is not None:
-            ann.__exit__(None, None, None)
-        with _lock:
-            slot = _spans.setdefault(name, [0, 0.0])
-            slot[0] += 1
-            slot[1] += dt
-            if _events_enabled:
-                _events.append({"name": name, "t0": t0, "t1": t1, "meta": meta})
-        logger.debug("span %s: %.6fs", name, dt)
-
-
-def add(name: str, n: int = 1) -> None:
-    """Bump a counter (e.g. ops folded, states merged, bytes decrypted)."""
-    with _lock:
-        _counters[name] = _counters.get(name, 0) + n
-
-
-def snapshot() -> dict:
-    """A consistent copy: {"spans": {name: {"count", "seconds"}},
-    "counters": {name: value}}."""
-    with _lock:
-        return {
-            "spans": {
-                k: {"count": c, "seconds": s} for k, (c, s) in _spans.items()
-            },
-            "counters": dict(_counters),
-        }
-
-
-def reset() -> None:
-    with _lock:
-        _spans.clear()
-        _counters.clear()
-        _events.clear()
-
-
-def report() -> str:
-    """Human-readable phase table, longest total first."""
-    snap = snapshot()
-    lines = []
-    spans = sorted(
-        snap["spans"].items(), key=lambda kv: kv[1]["seconds"], reverse=True
-    )
-    if spans:
-        w = max(len(k) for k, _ in spans)
-        for k, v in spans:
-            lines.append(
-                f"{k:<{w}}  {v['seconds']:>9.4f}s  x{v['count']}"
-            )
-    for k in sorted(snap["counters"]):
-        lines.append(f"{k} = {snap['counters'][k]}")
-    return "\n".join(lines) if lines else "(no spans recorded)"
-
-
-def throughput(span_name: str, counter_name: str) -> float | None:
-    """counter / span-seconds, or None if either is missing/zero."""
-    snap = snapshot()
-    s = snap["spans"].get(span_name)
-    c = snap["counters"].get(counter_name)
-    if not s or not c or s["seconds"] <= 0:
-        return None
-    return c / s["seconds"]
+sys.modules[__name__] = _record

@@ -36,7 +36,7 @@ def test_span_and_counter_accumulate():
     assert snap["counters"]["items"] == 7
     assert "phase.x" in trace.report()
     trace.reset()
-    assert trace.snapshot() == {"spans": {}, "counters": {}}
+    assert trace.snapshot() == {"spans": {}, "counters": {}, "gauges": {}}
 
 
 def test_span_records_on_exception():
