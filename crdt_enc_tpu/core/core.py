@@ -613,9 +613,21 @@ class Core:
         overlay: dict[Actor, int] = {}  # validated-but-unadvanced versions
         # decode runs in parallel threads (pure, GIL-released ctypes);
         # reduces drain strictly FIFO so per-actor cursor advancement stays
-        # in version order even under a mid-stream failure
+        # in version order even under a mid-stream failure.  The in-flight
+        # width is the asyncio twin of the thread pipeline's producer
+        # count (ops/stream.py stream_producer_count): the accelerator's
+        # configured fan-out, else the cpu-count auto-tune.
+        from ..ops.stream import stream_producer_count
+
         inflight: list[tuple] = []  # (decode_task, metas, files, clears)
-        MAX_DECODES = 2
+        n_producers = stream_producer_count(
+            getattr(self.accel, "stream_producers", 0)
+        )
+        # the gauge records the resolved fan-out width; the in-flight
+        # decode bound keeps its historical floor of 2 (one decode of
+        # lookahead even at width 1 — that lookahead IS the pipeline)
+        MAX_DECODES = max(2, n_producers)
+        trace.gauge("stream_producers", n_producers)
 
         async def finish_session():
             # state mutates ONLY here; must precede any python-mode fold

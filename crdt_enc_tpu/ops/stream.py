@@ -15,10 +15,16 @@ decrypt, native decode, columnarization, H2D staging — dominates a full
 single-dispatch compaction by ~40× (BASELINE config #5), so the pipeline
 here runs it CONCURRENTLY with the device fold:
 
-* a producer stage (one thread; its decrypt/decode calls are native and
-  release the GIL) ingests chunk k+1 while the consumer folds chunk k
-  (:func:`run_ingest_pipeline`, backpressure-bounded so at most ``depth``
-  chunks of host memory are ever live — default 2, the double buffer);
+* a producer pool (N threads pulling span indices from a shared cursor;
+  the decrypt/decode calls are native and release the GIL, so the
+  workers genuinely run in parallel) ingests chunks ahead of the fold
+  while a sequencer re-emits them to the consumer in STRICT chunk-index
+  order — the reduction order, and therefore the folded state bytes,
+  are identical at any N (:func:`run_ingest_pipeline`,
+  backpressure-bounded so at most ``depth`` chunks of host memory are
+  ever live — default ``producers + 1``: one chunk per worker in flight
+  plus one being reduced; :func:`stream_producer_count` auto-tunes N
+  from the core count with a ``CRDT_STREAM_PRODUCERS`` override);
 * the consumer issues the async ``jax.device_put`` of chunk k+1 BEFORE
   dispatching the donated fold of chunk k, so the H2D transfer rides
   under the previous fold's device execution
@@ -29,7 +35,9 @@ here runs it CONCURRENTLY with the device fold:
 
 Every stage is timed through ``utils.trace`` spans (``stream.decrypt``,
 ``stream.decode``, ``stream.ingest``, ``stream.h2d``, ``stream.fold``,
-``stream.reduce``, ``stream.d2h``) with the chunk index as span ``meta``,
+``stream.reduce``, ``stream.d2h``, plus ``stream.producer.wait`` /
+``stream.sequence`` and the ``stream_producers`` gauge for the fan-out
+stage) with the chunk index as span ``meta``,
 so the overlap is auditable from the event log
 (``trace.enable_events()``) — tests/test_streaming_pipeline.py pins that
 chunk k+1's ingest starts before chunk k's fold completes, and
@@ -45,6 +53,7 @@ tests pin the semantics at both extremes.
 
 from __future__ import annotations
 
+import os
 import queue as _queue
 import threading
 from functools import partial
@@ -55,6 +64,31 @@ import numpy as np
 from ..obs import runtime as obs_runtime
 from ..utils import trace
 from .orset import orset_fold
+
+# Fan-out auto-tune ceiling: past ~4 producers the shared memory bus (one
+# decrypt stream per worker) and the single consumer are the bottleneck
+# on every box we have measured — more workers just thrash caches.
+MAX_AUTO_PRODUCERS = 4
+
+
+def stream_producer_count(requested: int = 0) -> int:
+    """Resolve the ingest fan-out width (the N in the N-producer
+    pipeline): an explicit positive ``requested`` wins, then the
+    ``CRDT_STREAM_PRODUCERS`` env override, then an auto-tune from
+    ``os.cpu_count()`` — one core is left for the consumer (columnarize
+    + fold dispatch), capped at :data:`MAX_AUTO_PRODUCERS`."""
+    if requested > 0:
+        return int(requested)
+    env = os.environ.get("CRDT_STREAM_PRODUCERS", "")
+    if env.strip():
+        try:
+            n = int(env)
+        except ValueError:
+            n = 0
+        if n > 0:
+            return n
+    cpus = os.cpu_count() or 1
+    return max(1, min(MAX_AUTO_PRODUCERS, cpus - 1))
 
 
 @partial(
@@ -195,7 +229,7 @@ def iter_orset_chunks(
         yield k, m, a, c
 
 
-def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None):
+def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None, put=None):
     """The overlapped consumer loop: fold an iterable of host column
     chunks into device ``planes`` with one-chunk H2D lookahead.
 
@@ -204,7 +238,11 @@ def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None):
     (async), then the loop blocks on chunk k+1's transfer — which
     therefore rides under fold k's device execution — and recycles the
     host buffer to ``pool``.  ``fold_step`` must donate the planes and
-    may be the jitted folds above or a test double.
+    may be the jitted folds above or a test double.  ``put`` overrides
+    the per-array transfer (default ``jax.device_put``) — the sharded
+    streaming branch passes a ``NamedSharding``-targeted put so chunk
+    k+1's rows land dp-sharded across the mesh, still under chunk k's
+    fold.
 
     Returns the final device planes (NOT blocked: callers overlap their
     own epilogue, or block + pull under a ``stream.d2h`` span via
@@ -216,6 +254,8 @@ def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None):
     may ALIAS the host buffer zero-copy for the array's whole lifetime —
     there the buffer is held until the fold that consumes it completes
     (no overlap lost: host and "device" are the same silicon)."""
+    if put is None:
+        put = jax.device_put
     aliasing = pool is not None and jax.default_backend() == "cpu"
     pending = None  # device-resident chunk awaiting its fold dispatch
     pending_host = None  # its staging buffers (aliasing backends only)
@@ -226,7 +266,7 @@ def fold_chunks_overlapped(planes, chunks, fold_step, *, pool=None):
                 "h2d_bytes",
                 sum(getattr(x, "nbytes", 0) for x in host_chunk),
             )
-            dev_chunk = tuple(jax.device_put(x) for x in host_chunk)
+            dev_chunk = tuple(put(x) for x in host_chunk)
         if pending is not None:
             with trace.span("stream.fold", meta=k - 1):
                 planes = fold_step(planes, pending)
@@ -344,67 +384,132 @@ class PipelineError(Exception):
     original exception as ``__cause__``."""
 
 
-def run_ingest_pipeline(spans, ingest_fn, reduce_fn, *, depth: int = 2):
-    """Two-stage overlapped pipeline over ``spans`` (any sequence of work
+def run_ingest_pipeline(
+    spans, ingest_fn, reduce_fn, *, depth: int = 0, producers: int = 1
+):
+    """Ordered fan-out pipeline over ``spans`` (any sequence of work
     items, e.g. encrypted-blob slices).
 
-    A producer thread runs ``ingest_fn(span, k)`` — decrypt + decode;
-    host work whose native calls release the GIL — for chunk k+1 while
-    the calling thread runs ``reduce_fn(ingested, k)`` — columnarize +
-    fold — on chunk k.
+    ``producers`` worker threads pull span indices from a shared cursor
+    and run ``ingest_fn(span, k)`` — decrypt + decode; host work whose
+    native calls release the GIL — concurrently, while the calling
+    thread runs ``reduce_fn(ingested, k)`` — columnarize + fold.  A
+    sequencer on the calling thread re-emits completed chunks in STRICT
+    chunk-index order, so the reduction order — and therefore the
+    donated-fold planes and the resulting state bytes — is identical to
+    the single-producer pipeline whatever the workers' finish order.
 
-    Backpressure: a ``BoundedSemaphore(depth)`` is acquired BEFORE chunk
-    ingest starts and released only after its reduce completes, so at
-    most ``depth`` chunks are ever live host-side (default 2: the double
-    buffer — one being ingested, one being reduced).
+    Backpressure: a ``BoundedSemaphore(depth)`` is acquired BEFORE a
+    chunk is claimed and released only after its reduce completes, so at
+    most ``depth`` chunks are ever live host-side — including chunks the
+    sequencer is holding back.  ``depth=0`` auto-sizes to
+    ``max(2, producers + 1)``: one chunk per worker in flight plus one
+    being reduced (the N-producer generalization of the double buffer).
+    No deadlock is possible: indices are claimed in increasing order
+    immediately after a slot acquire, so the chunk the sequencer waits
+    for is always either unclaimed with a free slot on its way, or
+    already being ingested by a live worker.
 
-    Stage timing: ingest runs under a ``stream.ingest`` span and reduce
-    under ``stream.reduce``, both with ``meta=k`` — with
-    ``trace.enable_events()`` the event log shows ingest k+1 starting
-    before reduce k ends, which is the overlap proof the seam test pins.
+    Stage timing: each ingest runs under a ``stream.ingest`` span and
+    each reduce under ``stream.reduce``, both with ``meta=k``; workers
+    are named ``crdt-ingest-producer-<i>`` so the timeline export gives
+    each its own lane.  ``stream.producer.wait`` (meta = producer index)
+    times a worker's backpressure stall, ``stream.sequence`` (meta = k)
+    times the sequencer's wait for the next in-order chunk, and the
+    ``stream_producers`` gauge records the pool width of the run.
 
-    Errors: a producer exception surfaces here as :class:`PipelineError`
-    (original as ``__cause__``); a consumer exception stops the producer
-    at its next semaphore acquire and re-raises unchanged.
+    Errors: the first failing producer sets the shared stop flag — its
+    peers cancel at their next claim or slot poll, never claiming new
+    chunks — and the failure surfaces here as :class:`PipelineError`
+    (original as ``__cause__``) once every chunk BEFORE the failed index
+    has been reduced (chunks after it are discarded, releasing their
+    pending sequencer slots).  A consumer exception stops all producers
+    at their next poll and re-raises unchanged.  Either way the worker
+    threads are joined before this function returns.
     """
+    spans = list(spans)
+    n_spans = len(spans)
+    producers = max(1, int(producers))
+    if depth <= 0:
+        depth = max(2, producers + 1)
+    trace.gauge("stream_producers", producers)
+    if n_spans == 0:
+        return
     slots = threading.BoundedSemaphore(depth)
     out_q: _queue.Queue = _queue.Queue()
     stop = threading.Event()
+    cursor_lock = threading.Lock()
+    next_index = [0]
 
-    def produce():
+    def produce(pid: int):
+        k = None
         try:
-            for k, span in enumerate(spans):
-                # backpressure: wait for a live-chunk slot (poll so a dead
-                # consumer can't strand this thread forever)
-                while not slots.acquire(timeout=0.1):
-                    if stop.is_set():
-                        return
+            while True:
+                # backpressure BEFORE claiming an index: a worker must
+                # never sit on a claimed chunk while waiting for memory,
+                # or the sequencer could stall behind an unstarted chunk
+                # (poll so a dead consumer can't strand this thread)
+                with trace.span("stream.producer.wait", meta=pid):
+                    while not slots.acquire(timeout=0.1):
+                        if stop.is_set():
+                            return
                 if stop.is_set():
                     slots.release()
                     return
+                with cursor_lock:
+                    k = next_index[0]
+                    next_index[0] += 1
+                if k >= n_spans:
+                    slots.release()
+                    return
                 with trace.span("stream.ingest", meta=k):
-                    item = ingest_fn(span, k)
+                    item = ingest_fn(spans[k], k)
                 out_q.put(("chunk", k, item))
-            out_q.put(("end", None, None))
+                k = None
         except BaseException as e:  # noqa: BLE001 — relayed to consumer
-            out_q.put(("error", None, e))
+            stop.set()  # first failure cancels the peers
+            out_q.put(("error", k if k is not None else -1, e))
 
-    producer = threading.Thread(
-        target=produce, name="crdt-ingest-producer", daemon=True
-    )
-    producer.start()
+    workers = [
+        threading.Thread(
+            target=produce, args=(i,),
+            name=f"crdt-ingest-producer-{i}", daemon=True,
+        )
+        for i in range(producers)
+    ]
+    for w in workers:
+        w.start()
+    stash: dict[int, object] = {}
+    failures: dict[int, BaseException] = {}
+    expected = 0
     try:
-        while True:
-            tag, k, item = out_q.get()
-            if tag == "end":
-                return
-            if tag == "error":
-                raise PipelineError("ingest producer failed") from item
+        while expected < n_spans:
+            if failures and expected >= min(failures):
+                k = min(failures)
+                raise PipelineError(
+                    f"ingest producer failed at chunk {k}"
+                ) from failures[k]
+            if expected in stash:
+                item = stash.pop(expected)
+            else:
+                with trace.span("stream.sequence", meta=expected):
+                    while True:
+                        tag, k, item = out_q.get()
+                        if tag == "error":
+                            failures[k] = item
+                            break
+                        if k == expected:
+                            break
+                        stash[k] = item  # holds its slot until reduced
+                if tag == "error":
+                    continue  # drain the pre-failure prefix, then raise
             try:
-                with trace.span("stream.reduce", meta=k):
-                    reduce_fn(item, k)
+                with trace.span("stream.reduce", meta=expected):
+                    reduce_fn(item, expected)
             finally:
                 slots.release()
+            expected += 1
     finally:
         stop.set()
-        producer.join(timeout=30.0)
+        for w in workers:
+            w.join(timeout=30.0)

@@ -13,6 +13,8 @@ compiled programs (SURVEY.md §7 hard part 3).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..core.adapters import HostAccelerator
@@ -51,9 +53,38 @@ class TpuAccelerator(HostAccelerator):
         mesh=None,
         sparse_device: bool = False,
         map_fold_impl: str | None = None,
+        sharded_stream: bool | None = None,
+        stream_producers: int = 0,
     ):
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # mesh-sharded streaming fold (parallel/session.py
+        # _device_feed_sharded): None = auto — ON whenever the mesh is
+        # active, so a pod compaction streams through the SPMD kernels
+        # instead of buffering the whole row batch host-side.
+        # CRDT_SHARDED_STREAM=0/1 overrides the auto default; an
+        # unrecognized value keeps the auto default (never a silent
+        # opt-in from a typo'd opt-out).
+        if sharded_stream is None:
+            env = os.environ.get("CRDT_SHARDED_STREAM", "").strip().lower()
+            if env in ("0", "false", "off", "no", "disabled"):
+                sharded_stream = False
+            elif env in ("1", "true", "on", "yes", "enabled") or not env:
+                sharded_stream = True
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"CRDT_SHARDED_STREAM={env!r} not recognized; "
+                    "keeping the auto default (on with an active mesh)",
+                    stacklevel=2,
+                )
+                sharded_stream = True
+        self.sharded_stream = bool(sharded_stream) and self._mesh_active()
+        # ingest fan-out width for fold_encrypted_stream and the core's
+        # pipelined bulk ingest: 0 = auto (ops.stream.stream_producer_count
+        # — env CRDT_STREAM_PRODUCERS, else cpu_count-derived)
+        self.stream_producers = stream_producers
         # every XLA backend compile around the jitted/Pallas folds bumps
         # the jax_compiles counter — steady-state growth is the ADVICE-r5
         # unbounded-recompile bug class, now mechanically visible
@@ -410,24 +441,31 @@ class TpuAccelerator(HostAccelerator):
 
     def fold_encrypted_stream(
         self, state, key: bytes, blobs: list, *, actors_hint=(),
-        chunk_blobs: int = 0, n_chunks: int = 8, depth: int = 2,
-        n_threads: int = 0,
+        chunk_blobs: int = 0, n_chunks: int = 8, depth: int = 0,
+        n_threads: int = 0, n_producers: int = 0,
     ) -> bool:
         """The full overlapped streaming-compaction front end (BASELINE
         config #5 shape): encrypted op-file blobs in → folded ``state``
         out, with the host stages running CONCURRENTLY with the fold.
 
-        A producer thread runs threaded native decrypt
-        (``decrypt_blobs_packed``) + native columnar decode for chunk
-        k+1 while this thread columnarizes and folds chunk k through a
-        fold session (parallel/session.py — BUFFER / HOST_REDUCE /
-        DEVICE_STREAM by regime; the device mode issues chunk H2D under
-        the in-flight donated fold).  Backpressure bounds live host
-        memory to ``depth`` chunks (ops/stream.py
+        ``n_producers`` worker threads (0 = the accelerator's configured
+        ``stream_producers``, itself 0 = auto from the core count) run
+        threaded native decrypt (``decrypt_blobs_packed``) + native
+        columnar decode for upcoming chunks while this thread
+        columnarizes and folds the current one through a fold session
+        (parallel/session.py — BUFFER / HOST_REDUCE / DEVICE_STREAM by
+        regime; the device mode issues chunk H2D under the in-flight
+        donated fold, mesh-sharded when the accelerator's
+        ``sharded_stream`` route is active).  A sequencer re-emits
+        chunks in chunk-index order, so the folded bytes are identical
+        at any producer count.  Backpressure bounds live host memory to
+        ``depth`` chunks (0 = producers + 1; ops/stream.py
         ``run_ingest_pipeline``).  Per-stage trace spans
         (``stream.decrypt`` / ``stream.decode`` / ``stream.ingest`` /
-        ``stream.reduce`` / ``stream.finish``) make the overlap
-        auditable; ``bench.py --e2e-streaming`` publishes them.
+        ``stream.reduce`` / ``stream.finish``, plus the fan-out's
+        ``stream.producer.wait`` / ``stream.sequence`` and the
+        ``stream_producers`` gauge) make the overlap auditable;
+        ``bench.py --e2e-streaming`` publishes them.
 
         Returns False — with ``state`` untouched (sessions mutate only
         at finish) — when no session exists for this CRDT type or the
@@ -436,7 +474,7 @@ class TpuAccelerator(HostAccelerator):
         pipeline faults raise.
         """
         from ..backends.xchacha import decrypt_blobs, decrypt_blobs_packed
-        from ..ops.stream import run_ingest_pipeline
+        from ..ops.stream import run_ingest_pipeline, stream_producer_count
         from .session import SessionDeclined
 
         session = self.open_fold_session(state, actors_hint=actors_hint)
@@ -449,13 +487,22 @@ class TpuAccelerator(HostAccelerator):
             chunk_blobs = max(1, -(-n // max(n_chunks, 1)))
         spans = [blobs[i : i + chunk_blobs] for i in range(0, n, chunk_blobs)]
 
+        producers = stream_producer_count(
+            n_producers if n_producers > 0 else self.stream_producers
+        )
+        # each producer already owns a whole chunk: with several of them
+        # the parallelism is ACROSS chunks, so the in-chunk decrypt pool
+        # drops to one thread each — N single-threaded decrypt streams
+        # instead of one N-threaded one (same silicon, no oversubscribe)
+        chunk_threads = n_threads if n_threads else (1 if producers > 1 else 0)
+
         accepts_packed = getattr(session, "accepts_packed", False)
 
         def ingest(span, k):
             with trace.span("stream.decrypt", meta=k):
-                payloads = decrypt_blobs_packed(key, span, n_threads)
+                payloads = decrypt_blobs_packed(key, span, chunk_threads)
                 if payloads is None:
-                    payloads = decrypt_blobs(key, span, n_threads)
+                    payloads = decrypt_blobs(key, span, chunk_threads)
                 elif not accepts_packed:
                     # span-decoder-less sessions (counters, maps) take
                     # per-blob views of the shared cleartext buffer
@@ -475,7 +522,9 @@ class TpuAccelerator(HostAccelerator):
             session.reduce_chunk(decoded)
 
         try:
-            run_ingest_pipeline(spans, ingest, reduce, depth=depth)
+            run_ingest_pipeline(
+                spans, ingest, reduce, depth=depth, producers=producers
+            )
             with trace.span("stream.finish"):
                 session.finish()
         except SessionDeclined:

@@ -55,7 +55,7 @@ def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
 
 
 def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R,
-                impl="xla", tile_cap=0, interpret=False):
+                impl="xla", tile_cap=0, interpret=False, retire_rm=True):
     """Per-device body: fold this device's op rows into its member slice.
 
     ``member_lo`` is the first global member index of this device's slice;
@@ -66,6 +66,12 @@ def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R,
     kernel (ops/pallas_fold.py orset_scatter_pallas) — a mesh compaction
     then executes the same kernel a single chip does; the dp-pmax
     combine and normalize tail are identical either way.
+
+    ``retire_rm=False`` keeps remove horizons un-retired, exactly as in
+    ``ops.orset.orset_fold``: required when the planes are a PARTIAL
+    reduction (the sharded streaming fold) combined with a pre-existing
+    state later — a horizon retired against the batch-local clock would
+    lose its kill-effect on state entries it never met.
     """
     E_local = add0.shape[0]
     pad = actor >= R
@@ -117,7 +123,8 @@ def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R,
     add = jnp.maximum(add0, add_new)
     rm = jnp.maximum(rm0, rm_new)
     add = jnp.where(add > rm, add, 0)
-    rm = jnp.where(rm > clock[None, :], rm, 0)
+    if retire_rm:
+        rm = jnp.where(rm > clock[None, :], rm, 0)
     return clock, add, rm
 
 
@@ -133,6 +140,7 @@ def orset_fold_sharded(
     impl: str = "xla",
     tile_cap: int = 0,
     interpret: bool = False,
+    retire_rm: bool = True,
 ):
     """Sharded ORSet fold.
 
@@ -144,6 +152,9 @@ def orset_fold_sharded(
     ``impl="pallas"``: each shard's scatter phase runs the flagship ablk
     kernel (pass ``tile_cap`` from ``fold_cap`` over the WHOLE member
     column — it bounds every shard's tiles).
+
+    ``retire_rm=False``: partial-reduction mode for the sharded
+    streaming fold (see :func:`_local_fold`).
     """
     dp = mesh.shape["dp"]
     mp = mesh.shape["mp"]
@@ -163,6 +174,7 @@ def orset_fold_sharded(
         return _local_fold(
             clock0, add0, rm0, kind, member, actor, counter, member_lo[0], R,
             impl=impl, tile_cap=tile_cap, interpret=interpret,
+            retire_rm=retire_rm,
         )
 
     # each mp shard needs its global member offset
@@ -237,6 +249,70 @@ def pad_rows_for_mesh(cols, dp: int, num_replicas: int):
     n = len(cols.kind)
     target = ((n + dp - 1) // dp) * dp
     return K.pad_orset_rows(cols, target, num_replicas)
+
+
+# ---- sharded streaming fold ------------------------------------------------
+
+
+def stream_sharding(mesh: Mesh):
+    """The (rows, clock, planes) shardings of the streaming fold: op-row
+    chunks over ``dp``, the clock replicated, the (E, R) planes over
+    ``mp`` on the member axis."""
+    return (
+        NamedSharding(mesh, P("dp")),
+        NamedSharding(mesh, P()),
+        NamedSharding(mesh, P("mp", None)),
+    )
+
+
+def sharded_stream_planes(mesh: Mesh, E_pad: int, R: int):
+    """Zero-seeded accumulator planes for the sharded streaming fold,
+    placed with :func:`stream_sharding` (clock replicated, planes
+    mp-sharded).  ``E_pad`` must divide the mp axis."""
+    _, clock_s, plane_s = stream_sharding(mesh)
+    clock = jax.device_put(np.zeros(max(R, 1), np.int32), clock_s)
+    add = jax.device_put(np.zeros((E_pad, R), np.int32), plane_s)
+    rm = jax.device_put(np.zeros((E_pad, R), np.int32), plane_s)
+    return clock, add, rm
+
+
+# One compiled step per (mesh, kernel route): the streaming session calls
+# this per promotion/growth, and repeated compactions over the same mesh
+# must reuse the compiled program (the jax_compiles invariant) — jit
+# caches per function object, so the function object itself is cached.
+# BOUNDED LRU, not a weak dict: the step closure must capture the mesh
+# (shard_map needs it at trace time), so a weak key would be pinned by
+# its own value; eviction caps what a mesh-churning process can retain.
+_STREAM_STEP_CACHE: dict = {}
+_STREAM_STEP_CACHE_MAX = 8
+
+
+def sharded_stream_fold_step(
+    mesh: Mesh, impl: str = "xla", tile_cap: int = 0, interpret: bool = False
+):
+    """A donated ``(clock, add, rm), chunk → (clock, add, rm)`` step for
+    the sharded streaming fold: one jitted :func:`orset_fold_sharded`
+    with ``retire_rm=False`` (partial-reduction mode — the session's
+    finish retires once against the true merged clock, exactly like the
+    single-chip stream).  The planes are donated, so device memory stays
+    at one dp-sharded chunk + one mp-sharded set of planes however long
+    the stream runs."""
+    key = (mesh, impl, tile_cap, interpret)
+    step = _STREAM_STEP_CACHE.pop(key, None)
+    if step is None:
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(clock, add, rm, kind, member, actor, counter):
+            return orset_fold_sharded(
+                mesh, clock, add, rm, kind, member, actor, counter,
+                impl=impl, tile_cap=tile_cap, interpret=interpret,
+                retire_rm=False,
+            )
+
+    _STREAM_STEP_CACHE[key] = step  # re-insert = mark most-recently-used
+    while len(_STREAM_STEP_CACHE) > _STREAM_STEP_CACHE_MAX:
+        _STREAM_STEP_CACHE.pop(next(iter(_STREAM_STEP_CACHE)))
+    return step
 
 
 # ---- counters -------------------------------------------------------------

@@ -27,6 +27,12 @@ with regime (measured on v5e via the tunnel — see BASELINE.md):
   not the rows, dominate transfer; the planes live on device (donated
   between chunks, ops/stream.py) and fixed-shape row chunks stream
   through the compiled fold — device memory stays at one chunk + planes.
+  Under an ACTIVE MESH (and the accelerator's ``sharded_stream`` toggle,
+  auto-on) this mode goes SPMD (``_device_feed_sharded``): chunks
+  dp-sharded, donated planes mp-sharded, per-chunk ``orset_fold_sharded``
+  in partial-reduction mode — a pod compaction streams through the same
+  kernels the whole-batch sharded fold runs, instead of buffering the
+  entire row batch host-side.
 
 Exactness: every mode reproduces the one-big-``orset_fold`` semantics.
 HOST_REDUCE masks stale adds against the state clock captured at session
@@ -133,9 +139,11 @@ class OrsetFoldSession:
         self.rows_fed = 0
         # HOST_REDUCE accumulators (allocated at promotion)
         self._h_add = self._h_rm = None
-        # DEVICE_STREAM carry (allocated at promotion)
+        # DEVICE_STREAM carry (allocated at promotion); _d_sharded marks
+        # the mesh route (planes mp-sharded, chunks dp-sharded)
         self._d_planes = None
         self._d_E = 0
+        self._d_sharded = False
         self._finished = False
 
     # ------------------------------------------------------------------ feed
@@ -212,13 +220,26 @@ class OrsetFoldSession:
     def _promote(self) -> None:
         """Leave BUFFER mode: pick the cheap representation for this regime
         and replay the buffered chunks through it."""
-        if getattr(self.accel, "_mesh_active", lambda: False)():
-            # mesh ingests finish through the sharded fold, which wants the
-            # whole row batch — stay buffered (multi-chip compaction trades
-            # host memory for SPMD execution; revisit if it matters)
+        mesh_on = getattr(self.accel, "_mesh_active", lambda: False)()
+        sharded_ok = mesh_on and getattr(self.accel, "sharded_stream", False)
+        if sharded_ok:
+            import jax
+
+            if jax.process_count() > 1:
+                # the stream's growth and finish combine pull the
+                # mp-sharded planes to host (np.asarray), which only
+                # addresses LOCAL shards — on a multi-host pod that
+                # raises, so those meshes keep the buffered whole-batch
+                # sharded fold until a process_allgather combine lands
+                sharded_ok = False
+        if mesh_on and not sharded_ok:
+            # mesh ingests without the sharded streaming route finish
+            # through the whole-batch sharded fold — stay buffered
+            # (multi-chip compaction trades host memory for SPMD
+            # execution; the sharded_stream toggle removes the trade)
             return
         E_est = _bucket(max(len(self.members), 1))
-        if E_est * self.R <= HOST_PLANE_CELLS:
+        if not mesh_on and E_est * self.R <= HOST_PLANE_CELLS:
             self.mode = "host_reduce"
             self._h_add = np.zeros((E_est, self.R), np.int32)
             self._h_rm = np.zeros((E_est, self.R), np.int32)
@@ -226,6 +247,7 @@ class OrsetFoldSession:
                 self._host_reduce(*cols)
         else:
             self.mode = "device_stream"
+            self._d_sharded = mesh_on
             # overshoot the member capacity: every growth step recompiles
             # the donated fold for the new static shape, so fewer, larger
             # steps (the compile cache then amortizes across runs)
@@ -240,12 +262,23 @@ class OrsetFoldSession:
             # the event loop (core drain_one → to_thread)
             import jax
 
-            trace.add("h2d_bytes", 4 * (self.R + 2 * self._d_E * self.R))
-            self._d_planes = (
-                jax.device_put(np.zeros(max(self.R, 1), np.int32)),
-                jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
-                jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
-            )
+            if mesh_on:
+                # mp-sharded planes: each device owns E_pad/mp member rows
+                from . import mesh as pmesh
+
+                mp = self.accel.mesh.shape["mp"]
+                self._d_E = -(-self._d_E // mp) * mp
+                trace.add("h2d_bytes", 4 * (self.R + 2 * self._d_E * self.R))
+                self._d_planes = pmesh.sharded_stream_planes(
+                    self.accel.mesh, self._d_E, self.R
+                )
+            else:
+                trace.add("h2d_bytes", 4 * (self.R + 2 * self._d_E * self.R))
+                self._d_planes = (
+                    jax.device_put(np.zeros(max(self.R, 1), np.int32)),
+                    jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
+                    jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
+                )
             for cols in self._buffered:
                 self._device_feed(*cols)
         self._buffered = []
@@ -336,6 +369,27 @@ class OrsetFoldSession:
     # ------------------------------------------------ device-stream internals
     def _grow_device_planes(self) -> None:
         E_new = _bucket(len(self.members) * 4)  # overshoot (see _promote)
+        if self._d_sharded:
+            from . import mesh as pmesh
+
+            mp = self.accel.mesh.shape["mp"]
+            E_new = -(-E_new // mp) * mp
+            if E_new <= self._d_E:
+                return
+            # growth is rare (4× overshoot): a host round-trip keeps the
+            # mp re-shard trivial instead of a resharding pad program
+            _, clock_s, plane_s = pmesh.stream_sharding(self.accel.mesh)
+            import jax
+
+            clock, add, rm = (np.asarray(x) for x in self._d_planes)
+            z = np.zeros((E_new - self._d_E, add.shape[1]), np.int32)
+            self._d_planes = (
+                jax.device_put(clock, clock_s),
+                jax.device_put(np.concatenate([add, z]), plane_s),
+                jax.device_put(np.concatenate([rm, z]), plane_s),
+            )
+            self._d_E = E_new
+            return
         if E_new > self._d_E:
             import jax.numpy as jnp
 
@@ -346,7 +400,63 @@ class OrsetFoldSession:
             self._d_planes = (clock, add, rm)
             self._d_E = E_new
 
+    def _device_feed_sharded(self, kind, member, actor, counter) -> None:
+        """DEVICE_STREAM over the accelerator's mesh: the SPMD twin of
+        :meth:`_device_feed`.  Rows pad to the dp axis
+        (``pad_rows_for_mesh``) and stream as dp-sharded fixed-shape
+        chunks through the donated ``orset_fold_sharded`` step
+        (``retire_rm=False`` — partial-reduction mode, identical combine
+        discipline to the single-chip stream); the accumulator planes
+        stay mp-sharded on device between chunks, and chunk k+1's
+        sharded ``device_put`` is still issued under chunk k's in-flight
+        fold (``fold_chunks_overlapped`` with a sharded ``put``).  The
+        per-shard scatter runs the XLA segment-max kernel — the
+        per-shard Pallas route needs a shard-local tile cap per chunk,
+        which would recompile per chunk; the whole-batch sharded fold
+        keeps that kernel."""
+        import jax
+
+        from ..ops.stream import fold_chunks_overlapped, iter_orset_chunks
+        from . import mesh as pmesh
+
+        mesh = self.accel.mesh
+        dp = mesh.shape["dp"]
+        if len(self.members) > self._d_E:
+            self._grow_device_planes()
+        cols = K.OrsetColumns(
+            np.asarray(kind, np.int8),
+            np.asarray(member, np.int32),
+            np.asarray(actor, np.int32),
+            np.asarray(counter, np.int32),
+            self.members,
+            self.replicas,
+        )
+        pmesh.pad_rows_for_mesh(cols, dp, self.R)
+        rows = min(DEVICE_CHUNK_ROWS, _bucket(len(cols.kind)))
+        rows = -(-rows // dp) * dp  # the fixed chunk shape must divide dp
+        step = pmesh.sharded_stream_fold_step(mesh)
+        row_s, _, _ = pmesh.stream_sharding(mesh)
+
+        def put(x):
+            return jax.device_put(x, row_s)
+
+        def fold_step(planes, chunk):
+            return step(*planes, *chunk)
+
+        with trace.span("session.device_fold"):
+            self._d_planes = fold_chunks_overlapped(
+                self._d_planes,
+                iter_orset_chunks(
+                    cols.kind, cols.member, cols.actor, cols.counter,
+                    rows, self.R,
+                ),
+                fold_step,
+                put=put,
+            )
+
     def _device_feed(self, kind, member, actor, counter) -> None:
+        if self._d_sharded:
+            return self._device_feed_sharded(kind, member, actor, counter)
         import jax
 
         from ..ops import pallas_fold as PF

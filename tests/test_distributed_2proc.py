@@ -19,8 +19,29 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_dist_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Environment guard: some jaxlib builds cannot run 2-process collectives
+# on the CPU backend at all ("Multiprocess computations aren't
+# implemented on the CPU backend") — a capability gap of the box, not a
+# regression in this repo's distributed layer.  Those runs SKIP with the
+# exact backend message; any other worker failure still fails the test.
+_ENV_SKIP_MARKERS = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "multiprocess computations aren't implemented",
+)
+
+
+def _skip_if_env_limited(out: str, err: str) -> None:
+    for marker in _ENV_SKIP_MARKERS:
+        if marker.lower() in (out + err).lower():
+            pytest.skip(
+                "2-proc jax.distributed unavailable on this box: "
+                f"jaxlib reports {marker!r}"
+            )
 
 
 def _free_port() -> int:
@@ -56,6 +77,8 @@ def _run_workers(extra_args=(), timeout=300):
         for p in procs:
             p.kill()
     for rank, (p, (out, err)) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            _skip_if_env_limited(out, err)
         assert p.returncode == 0, (
             f"rank {rank} exited {p.returncode}\nstdout:\n{out}\n"
             f"stderr:\n{err}"

@@ -163,16 +163,22 @@ def test_consumer_error_stops_producer():
     assert len(ingested) <= 4
     # ... and the producer thread itself wound down (the pipeline joins
     # it on exit; poll briefly in case the runtime is slow to reap)
+    _assert_no_producer_threads()
+
+
+def _assert_no_producer_threads():
     deadline = time.time() + 5.0
     while time.time() < deadline and any(
-        t.name == "crdt-ingest-producer" and t.is_alive()
+        t.name.startswith("crdt-ingest-producer") and t.is_alive()
         for t in threading.enumerate()
     ):
         time.sleep(0.01)
-    assert not any(
-        t.name == "crdt-ingest-producer" and t.is_alive()
+    leaked = [
+        t.name
         for t in threading.enumerate()
-    )
+        if t.name.startswith("crdt-ingest-producer") and t.is_alive()
+    ]
+    assert not leaked, f"leaked producer threads: {leaked}"
 
 
 # ----------------------------------------------------------- chunk staging
@@ -399,3 +405,403 @@ def test_streaming_pipeline_seam_on_real_path():
                      "stream.reduce", "stream.finish"):
         assert required in names, f"missing stage span {required}"
     assert codec.pack(streamed.to_obj()) == codec.pack(host.to_obj())
+
+
+# ------------------------------------------------- multi-producer fan-out
+
+
+def test_producer_count_resolution(monkeypatch):
+    """stream_producer_count: explicit request > env override > the
+    cpu-count auto-tune (one core left for the consumer, capped)."""
+    from crdt_enc_tpu.ops.stream import MAX_AUTO_PRODUCERS
+
+    monkeypatch.delenv("CRDT_STREAM_PRODUCERS", raising=False)
+    assert K.stream_producer_count(3) == 3
+    auto = K.stream_producer_count()
+    import os
+
+    cpus = os.cpu_count() or 1
+    assert auto == max(1, min(MAX_AUTO_PRODUCERS, cpus - 1))
+    monkeypatch.setenv("CRDT_STREAM_PRODUCERS", "7")
+    assert K.stream_producer_count() == 7
+    assert K.stream_producer_count(2) == 2  # explicit still wins
+    monkeypatch.setenv("CRDT_STREAM_PRODUCERS", "not-a-number")
+    assert K.stream_producer_count() == auto
+
+
+def test_multi_producer_order_deterministic():
+    """The sequencer re-emits chunks in strict index order whatever the
+    workers' finish order — pinned with randomized per-chunk delays at
+    several fan-out widths."""
+    rng = np.random.default_rng(17)
+    delays = rng.random(24) * 0.01
+    for producers in (1, 2, 4):
+        order = []
+
+        def ingest(span, k):
+            time.sleep(delays[k])
+            return span * 10
+
+        def reduce(item, k):
+            order.append((k, item))
+
+        K.run_ingest_pipeline(
+            list(range(24)), ingest, reduce, producers=producers
+        )
+        assert order == [(k, 10 * k) for k in range(24)], (producers, order)
+
+
+def test_multi_producer_lanes_and_gauge():
+    """N workers run under numbered thread lanes, the stream_producers
+    gauge records the pool width, and the fan-out spans
+    (stream.producer.wait, stream.sequence) are emitted."""
+    trace.reset()
+    trace.enable_events()
+    try:
+        K.run_ingest_pipeline(
+            list(range(8)),
+            lambda span, k: time.sleep(0.005) or span,
+            lambda item, k: time.sleep(0.002),
+            producers=2,
+        )
+    finally:
+        trace.enable_events(False)
+    snap = trace.snapshot()
+    assert snap["gauges"]["stream_producers"] == 2
+    events = trace.events()
+    names = {e["name"] for e in events}
+    assert {"stream.producer.wait", "stream.sequence"} <= names
+    lanes = {
+        e["thread"] for e in events if e["name"] == "stream.ingest"
+    }
+    assert lanes == {"crdt-ingest-producer-0", "crdt-ingest-producer-1"}
+    trace.reset()
+
+
+def test_multi_producer_overlap_seam():
+    """With 2 producers and slow reduces, some chunk's ingest still
+    starts before the previous chunk's reduce completes — the same
+    overlap proof the single-producer seam test pins."""
+    trace.reset()
+    trace.enable_events()
+    try:
+        K.run_ingest_pipeline(
+            list(range(6)),
+            lambda span, k: time.sleep(0.02) or span,
+            lambda item, k: time.sleep(0.05),
+            producers=2,
+        )
+    finally:
+        trace.enable_events(False)
+    ingests = _events_by_name("stream.ingest")
+    reduces = _events_by_name("stream.reduce")
+    assert [e["meta"] for e in reduces] == list(range(6))
+    assert any(
+        ingests[k + 1]["t0"] < reduces[k]["t1"] for k in range(5)
+    ), "no overlap with 2 producers"
+
+
+def test_multi_producer_backpressure_bound():
+    """At most depth chunks are ever live host-side, stashed sequencer
+    chunks included: chunk k+depth's ingest cannot start before chunk
+    k's reduce released its slot."""
+    trace.reset()
+    trace.enable_events()
+    depth = 3
+    try:
+        K.run_ingest_pipeline(
+            list(range(8)),
+            lambda span, k: span,
+            lambda item, k: time.sleep(0.02),
+            depth=depth,
+            producers=2,
+        )
+    finally:
+        trace.enable_events(False)
+    ingests = _events_by_name("stream.ingest")
+    reduces = _events_by_name("stream.reduce")
+    for k in range(len(ingests) - depth):
+        assert ingests[k + depth]["t0"] >= reduces[k]["t1"], (
+            f"chunk {k + depth} ingested before chunk {k}'s slot released"
+        )
+
+
+def test_multi_producer_fault_injection():
+    """The first failing producer cancels its peers and the pending
+    sequencer slots: every chunk BEFORE the failed index is reduced in
+    order, the failure surfaces as PipelineError with the original as
+    __cause__, no worker thread leaks, and the pipeline is reusable
+    afterwards (no deadlocked BoundedSemaphore state escapes)."""
+    rng = np.random.default_rng(3)
+    delays = rng.random(30) * 0.008
+    reduced = []
+
+    def ingest(span, k):
+        time.sleep(delays[k])
+        if k == 7:
+            raise ValueError("producer boom")
+        return span
+
+    def reduce(item, k):
+        reduced.append(k)
+
+    with pytest.raises(K.PipelineError) as ei:
+        K.run_ingest_pipeline(
+            list(range(30)), ingest, reduce, producers=3
+        )
+    assert isinstance(ei.value.__cause__, ValueError)
+    # deterministic drain: exactly the pre-failure prefix, in order
+    assert reduced == list(range(7)), reduced
+    _assert_no_producer_threads()
+    # a fresh run right after the fault completes normally (nothing
+    # leaked into module or interpreter state)
+    order = []
+    K.run_ingest_pipeline(
+        list(range(10)), lambda s, k: s, lambda i, k: order.append(k),
+        producers=3,
+    )
+    assert order == list(range(10))
+
+
+def test_multi_producer_consumer_error_cancels_pool():
+    """A consumer failure stops every producer at its next poll."""
+    ingested = []
+
+    def ingest(span, k):
+        ingested.append(k)
+        return span
+
+    def reduce(item, k):
+        raise RuntimeError("reduce failed")
+
+    with pytest.raises(RuntimeError, match="reduce failed"):
+        K.run_ingest_pipeline(
+            list(range(50)), ingest, reduce, depth=4, producers=3
+        )
+    # backpressure bounds how far the pool ran ahead of the failure
+    assert len(ingested) <= 8
+    _assert_no_producer_threads()
+
+
+def test_multi_producer_byte_identical_to_single():
+    """ISSUE 3 acceptance (differential): the SAME encrypted span set
+    folded with 1, 2, and 4 producers — with randomized producer delays
+    injected ahead of the real decrypt — produces byte-identical states,
+    all equal to the per-op host reference."""
+    _native_crypto_or_skip()
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.parallel import TpuAccelerator
+
+    key, blobs, actors, host = _encrypted_orset_workload(
+        n_files=48, ops_per_file=7, seed=21
+    )
+    host_bytes = codec.pack(host.to_obj())
+    accel = TpuAccelerator()
+    hint = sorted(actors)
+    rng = np.random.default_rng(9)
+    delays = rng.random(12) * 0.01
+
+    from crdt_enc_tpu.ops import stream as stream_mod
+
+    real_pipeline = stream_mod.run_ingest_pipeline
+
+    def jittered_pipeline(spans, ingest_fn, reduce_fn, **kw):
+        def slow_ingest(span, k):
+            time.sleep(delays[k % len(delays)])
+            return ingest_fn(span, k)
+
+        return real_pipeline(spans, slow_ingest, reduce_fn, **kw)
+
+    results = {}
+    for n_producers in (1, 2, 4):
+        streamed = ORSet()
+        stream_mod.run_ingest_pipeline = jittered_pipeline
+        try:
+            ok = accel.fold_encrypted_stream(
+                streamed, key, blobs, actors_hint=hint, n_chunks=8,
+                n_producers=n_producers,
+            )
+        finally:
+            stream_mod.run_ingest_pipeline = real_pipeline
+        assert ok, f"pipeline declined at n_producers={n_producers}"
+        results[n_producers] = codec.pack(streamed.to_obj())
+    for n_producers, got in results.items():
+        assert got == host_bytes, f"divergence at n_producers={n_producers}"
+
+
+# ------------------------------------------------- mesh-sharded streaming
+
+
+def _mesh_or_skip():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    from crdt_enc_tpu.parallel import mesh as pmesh
+
+    return pmesh.make_mesh((4, 2))
+
+
+def test_sharded_stream_byte_identical_to_single_chip(monkeypatch):
+    """ISSUE 3 acceptance (sharded differential): the SAME encrypted
+    span set folded through the mesh-sharded streaming branch
+    (session._device_feed_sharded → orset_fold_sharded, planes
+    mp-sharded, chunks dp-sharded) and through the single-chip stream is
+    byte-identical — both equal to the per-op host reference."""
+    _native_crypto_or_skip()
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.parallel import TpuAccelerator, mesh as pmesh
+    from crdt_enc_tpu.parallel import session as psession
+
+    mesh = _mesh_or_skip()
+    # tiny promotion threshold so the small workload leaves BUFFER mode
+    monkeypatch.setattr(psession, "BUFFER_BYTES", 256)
+
+    key, blobs, actors, host = _encrypted_orset_workload(
+        n_files=60, ops_per_file=8, R=5, E=24, seed=13
+    )
+    host_bytes = codec.pack(host.to_obj())
+    hint = sorted(actors)
+
+    accel = TpuAccelerator(mesh=mesh)
+    assert accel.sharded_stream  # auto-on with an active mesh
+
+    # spy: the sharded fold step must actually run (not a silent
+    # fallback to the single-chip or buffered route)
+    calls = []
+    real_step = pmesh.sharded_stream_fold_step
+
+    def spy_step(*a, **kw):
+        step = real_step(*a, **kw)
+
+        def wrapped(*args):
+            calls.append(1)
+            return step(*args)
+
+        return wrapped
+
+    monkeypatch.setattr(pmesh, "sharded_stream_fold_step", spy_step)
+
+    sharded = ORSet()
+    ok = accel.fold_encrypted_stream(
+        sharded, key, blobs, actors_hint=hint, n_chunks=6, n_producers=2,
+    )
+    assert ok and calls, "sharded streaming fold did not engage"
+    assert codec.pack(sharded.to_obj()) == host_bytes
+
+    single = ORSet()
+    ok = TpuAccelerator().fold_encrypted_stream(
+        single, key, blobs, actors_hint=hint, n_chunks=6,
+    )
+    assert ok
+    assert codec.pack(single.to_obj()) == host_bytes
+
+
+def test_sharded_stream_into_existing_state(monkeypatch):
+    """The sharded stream's finish combine uses op-APPLY semantics
+    against the live state (retire_rm=False partial reduction): remove
+    horizons streamed through the mesh still kill pre-existing entries,
+    and stale dots are still rejected."""
+    _native_crypto_or_skip()
+    from crdt_enc_tpu.backends.xchacha import decrypt_blobs
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.models.orset import AddOp, RmOp
+    from crdt_enc_tpu.models.vclock import Dot, VClock
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.parallel import session as psession
+
+    mesh = _mesh_or_skip()
+    monkeypatch.setattr(psession, "BUFFER_BYTES", 256)
+
+    key, blobs, actors, _ = _encrypted_orset_workload(
+        n_files=48, ops_per_file=8, R=4, E=16, seed=29
+    )
+    pre = [(b"\x77" * 16, 1, 3), (b"\x78" * 16, 2, 5)]
+    streamed = ORSet()
+    host = ORSet()
+    for a, c, m in pre:
+        op = AddOp(m, Dot(a, c))
+        streamed.apply(op)
+        host.apply(op)
+    for raw in decrypt_blobs(key, blobs):
+        for o in codec.unpack(raw):
+            if o[0] == 0:
+                host.apply(AddOp(o[1], Dot.from_obj(o[2])))
+            else:
+                host.apply(RmOp(o[1], VClock.from_obj(o[2])))
+
+    accel = TpuAccelerator(mesh=mesh)
+    ok = accel.fold_encrypted_stream(
+        streamed, key, blobs, actors_hint=sorted(actors), n_chunks=5,
+    )
+    assert ok
+    assert codec.pack(streamed.to_obj()) == codec.pack(host.to_obj())
+
+
+def test_sharded_stream_gated_off_multiprocess(monkeypatch):
+    """On a multi-host pod (jax.process_count() > 1) the sharded stream
+    must NOT engage: its growth/finish combine pulls the mp-sharded
+    planes to host, which only addresses local shards — those meshes
+    keep the buffered whole-batch sharded fold."""
+    import jax
+
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.parallel import session as psession
+
+    mesh = _mesh_or_skip()
+    monkeypatch.setattr(psession, "BUFFER_BYTES", 64)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    accel = TpuAccelerator(mesh=mesh)
+    assert accel.sharded_stream  # the toggle itself stays on...
+    session = accel.open_fold_session(ORSet(), actors_hint=[b"\x01" * 16])
+    n = 40
+    decoded = (
+        np.zeros(n, np.int8),
+        np.arange(n, dtype=np.int32) % 8,
+        np.arange(n, dtype=np.int32) % 3,
+        np.arange(n, dtype=np.int32) + 1,
+        [bytes([m]) for m in range(8)],
+    )
+    session.reduce_chunk(decoded)
+    # ...but the session refuses the promotion (local-shard host pulls)
+    assert session.mode == "buffer" and not session._d_sharded
+
+
+def test_sharded_stream_toggle_off_stays_buffered(monkeypatch):
+    """sharded_stream=False (or CRDT_SHARDED_STREAM=0) preserves the
+    historical buffered-mesh session: no promotion, finish through the
+    whole-batch sharded fold."""
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.parallel import session as psession
+
+    mesh = _mesh_or_skip()
+    monkeypatch.setattr(psession, "BUFFER_BYTES", 64)
+    actors = [bytes([a]) * 16 for a in range(1, 4)]
+
+    def feed_rows(accel):
+        session = accel.open_fold_session(ORSet(), actors_hint=actors)
+        # synthetic decoded chunks (kind, member_idx, actor_idx, counter,
+        # member_objs) — enough rows to blow the 64-byte buffer twice
+        for base in (0, 40):
+            n = 40
+            decoded = (
+                np.zeros(n, np.int8),
+                np.arange(n, dtype=np.int32) % 8,
+                np.arange(n, dtype=np.int32) % 3,
+                np.arange(n, dtype=np.int32) + 1 + base,
+                [bytes([m]) for m in range(8)],
+            )
+            session.reduce_chunk(decoded)
+        return session
+
+    off = feed_rows(TpuAccelerator(mesh=mesh, sharded_stream=False))
+    assert off.mode == "buffer" and not off._d_sharded
+
+    on = feed_rows(TpuAccelerator(mesh=mesh))
+    assert on.mode == "device_stream" and on._d_sharded
+
+    monkeypatch.setenv("CRDT_SHARDED_STREAM", "0")
+    env_off = TpuAccelerator(mesh=mesh)
+    assert not env_off.sharded_stream

@@ -14,8 +14,13 @@ the obs-internal spelling) and fails when
 
 Registry entries no longer present in code are reported as warnings
 (stale doc rows) without failing, so conditionally-compiled call sites
-don't break CI.  ``tests/`` is exempt (scratch names).  Run directly or
-via the tier-1 suite (tests/test_obs.py).
+don't break CI — EXCEPT the ``stream.*`` pipeline family (which
+includes the fan-out's ``stream.producer.*`` lanes): those spans are
+load-bearing for the overlap/backpressure proofs the streaming tests
+and ``obs_report --check-overlap`` read, so a registered ``stream.*``
+name with no call site is an ERROR (the proof would silently read an
+empty timeline).  ``tests/`` is exempt (scratch names).  Run directly
+or via the tier-1 suite (tests/test_obs.py).
 """
 
 from __future__ import annotations
@@ -106,10 +111,26 @@ def main(argv=None) -> int:
             errors += 1
     # names maintained inside obs.record itself (no trace.* call site)
     internal = {"events_dropped"}
+    # the streaming-pipeline family backs machine-checked proofs
+    # (chunk_overlaps, the seam/backpressure tests): a registered
+    # stream.* name that nothing emits means a proof reads nothing
+    PROOF_PREFIXES = ("stream.",)
     for stale in sorted(registered - used - internal):
+        if stale.startswith(PROOF_PREFIXES):
+            print(
+                f"ERROR registry entry `{stale}` ({PROOF_PREFIXES[0]}* "
+                "family) has no literal call site — the overlap proofs "
+                "would read an empty timeline"
+            )
+            errors += 1
+            continue
         print(f"WARN registry entry `{stale}` has no literal call site")
     if errors:
-        print(f"{errors} unregistered name(s)", file=sys.stderr)
+        print(
+            f"{errors} registry violation(s) — unregistered names and/or "
+            "call-site-less stream.* proof spans, see ERROR lines",
+            file=sys.stderr,
+        )
         return 1
     print(f"OK: {len(used)} names used, all registered")
     return 0
