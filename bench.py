@@ -530,10 +530,310 @@ def _timed_host(fn):
     return time.perf_counter() - t0
 
 
+def e2e_warm_open(smoke: bool):
+    """ISSUE-4 acceptance: cold open vs checkpointed (warm) open of a
+    config-5-shaped un-compacted remote with a 1% op tail.
+
+    A real FS remote is populated with N three-layer-sealed op files
+    across R actors; replica A reads it all once and seals a local fold
+    checkpoint.  Then a 1% tail of new op files lands and we measure,
+    on the SAME remote:
+
+    * **cold** — a fresh replica (no local state) opens and refolds the
+      entire history through the streaming ingest, and
+    * **warm** — replica A reopens: the checkpoint restores the
+      materialized state + cursor and only the tail is decrypted,
+      decoded and folded.
+
+    Byte equality of the two resulting states is asserted, both obs
+    snapshots are recorded, and a two-round-compact h2d_bytes sample
+    proves the device-resident plane reuse (round 2 re-uploads no
+    full-state planes).  Appends the record to BENCH_LOCAL.jsonl
+    (BENCH_LOCAL_ALL=1 to record CPU runs).
+
+    Env knobs: BENCH_WARM_OPS (1_000_000), BENCH_WARM_REPLICAS (10_000),
+    BENCH_WARM_MEMBERS (1024), BENCH_WARM_OPF (48, ops per file),
+    BENCH_WARM_TAIL_PCT (1.0).
+    """
+    import asyncio
+    import tempfile
+
+    N = int(os.environ.get("BENCH_WARM_OPS", 20_000 if smoke else 1_000_000))
+    R = int(os.environ.get("BENCH_WARM_REPLICAS", 200 if smoke else 10_000))
+    E = int(os.environ.get("BENCH_WARM_MEMBERS", 128 if smoke else 1024))
+    OPF = int(os.environ.get("BENCH_WARM_OPF", 48))
+    TAIL_PCT = float(os.environ.get("BENCH_WARM_TAIL_PCT", 1.0))
+
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    first_platform = platforms.split(",")[0].strip() if platforms else ""
+    want_tpu = first_platform not in ("cpu",) and not smoke
+    jax, dev = acquire_jax(want_tpu)
+
+    import crdt_enc_tpu
+    from benchmarks.suite import actor_bytes_table
+    from crdt_enc_tpu.backends import (
+        FsStorage, PlainKeyCryptor, XChaChaCryptor,
+    )
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.models import canonical_bytes
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.utils import trace
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    crdt_enc_tpu.enable_compilation_cache()
+
+    def opts(storage, create):
+        return OpenOptions(
+            storage=storage,
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=create,
+            accelerator=TpuAccelerator(),
+        )
+
+    # ---- per-actor op files from the config-3/5 column generator,
+    # sealed in the core's real three-layer wire format
+    kind, member, actor, counter = gen_columns(N, R, E, seed=11)
+    actors = actor_bytes_table(R)
+    live = actor < R
+    order = np.argsort(actor[live], kind="stable")
+    k_l = kind[live][order]
+    m_l = member[live][order]
+    a_l = actor[live][order]
+    c_l = counter[live][order]
+
+    def file_payloads():
+        """Yield (actor_bytes, version, ops_obj) per file, versions dense
+        from 1 per actor."""
+        i, n = 0, len(k_l)
+        versions: dict = {}
+        while i < n:
+            j = min(i + OPF, n)
+            j = i + int(np.searchsorted(a_l[i:j], a_l[i], side="right"))
+            ab = actors[int(a_l[i])]
+            ops = []
+            for t in range(i, j):
+                if k_l[t] == 0:
+                    ops.append([0, int(m_l[t]), [ab, int(c_l[t])]])
+                else:
+                    ops.append([1, int(m_l[t]), {ab: int(c_l[t])}])
+            v = versions.get(ab, 0) + 1
+            versions[ab] = v
+            yield ab, v, ops
+            i = j
+
+    files = list(file_payloads())
+    # the TAIL_PCT% op tail: final files (one per contributing actor)
+    # held back until the checkpoint is sealed, accumulating actors
+    # until the tail holds ~TAIL_PCT% of all ops
+    total_ops = sum(len(ops) for _, _, ops in files)
+    last_file_idx = {}
+    for idx, (ab, v, _) in enumerate(files):
+        last_file_idx[ab] = idx
+    target_ops = max(1, int(total_ops * TAIL_PCT / 100.0))
+    tail_idx: set = set()
+    n_tail_ops = 0
+    for ab in actors:
+        idx = last_file_idx.get(ab)
+        if idx is None:
+            continue
+        tail_idx.add(idx)
+        n_tail_ops += len(files[idx][2])
+        if n_tail_ops >= target_ops:
+            break
+    prefix = [f for i, f in enumerate(files) if i not in tail_idx]
+    tail = [f for i, f in enumerate(files) if i in tail_idx]
+
+    tmp = tempfile.mkdtemp(prefix="crdt-warm-open-")
+    remote = os.path.join(tmp, "remote")
+    log(
+        f"e2e_warm_open: device {dev.platform}; {len(files)} files "
+        f"({len(tail)} tail), {total_ops} ops ({n_tail_ops} tail), "
+        f"R={R} E={E} remote={remote}"
+    )
+
+    async def build_and_measure():
+        storage_a = FsStorage(os.path.join(tmp, "localA"), remote)
+        core_a = await Core.open(opts(storage_a, create=True))
+
+        async def store_files(batch):
+            sem = asyncio.Semaphore(64)
+
+            async def one(ab, v, ops):
+                async with sem:
+                    blob = await core_a._seal(ops)
+                    await core_a.storage.store_ops(ab, v, blob)
+
+            await asyncio.gather(*(one(*f) for f in batch))
+
+        t0 = time.perf_counter()
+        CHUNK = 2048  # bound in-flight seal buffers
+        for i in range(0, len(prefix), CHUNK):
+            await store_files(prefix[i : i + CHUNK])
+        t_build = time.perf_counter() - t0
+        log(f"remote built: {len(prefix)} files in {t_build:.1f}s")
+
+        # replica A folds the full history once and seals its resume point
+        t0 = time.perf_counter()
+        await core_a.read_remote()
+        t_first = time.perf_counter() - t0
+        trace.reset()
+        await core_a.save_checkpoint()
+        ck_bytes = trace.snapshot()["counters"].get("checkpoint_bytes", 0)
+        log(f"first full fold: {t_first:.2f}s; checkpoint sealed "
+            f"({ck_bytes} bytes)")
+
+        await store_files(tail)
+
+        # ---- cold: a fresh replica refolds EVERYTHING
+        trace.reset()
+        t0 = time.perf_counter()
+        core_cold = await Core.open(
+            opts(FsStorage(os.path.join(tmp, "localB"), remote), create=True)
+        )
+        await core_cold.read_remote()
+        t_cold = time.perf_counter() - t0
+        obs_cold = trace.snapshot()
+
+        # ---- warm: replica A reopens from its checkpoint + 1% tail
+        trace.reset()
+        t0 = time.perf_counter()
+        core_warm = await Core.open(
+            opts(FsStorage(os.path.join(tmp, "localA"), remote), create=False)
+        )
+        warm_hit = core_warm.opened_from_checkpoint
+        await core_warm.read_remote()
+        t_warm = time.perf_counter() - t0
+        obs_warm = trace.snapshot()
+
+        equal = core_cold.with_state(canonical_bytes) == core_warm.with_state(
+            canonical_bytes
+        )
+        return (
+            t_build, t_first, t_cold, t_warm, warm_hit, equal,
+            obs_cold, obs_warm, core_warm.checkpoint_fallback_reason,
+            ck_bytes,
+        )
+
+    (t_build, t_first, t_cold, t_warm, warm_hit, equal, obs_cold, obs_warm,
+     fallback, ck_bytes) = asyncio.run(build_and_measure())
+
+    # ---- device-resident plane reuse: two-round compact h2d sample
+    plane_proof = asyncio.run(_plane_reuse_rounds())
+
+    speedup = t_cold / t_warm
+    log(
+        f"cold open {t_cold:.2f}s vs warm open {t_warm:.3f}s → "
+        f"{speedup:.1f}x (warm hit: {warm_hit}, equal: {equal})"
+    )
+    result = {
+        "metric": "orset_e2e_warm_open_speedup",
+        "config": f"warm_open_{N}ops_{R}r_{TAIL_PCT:g}pct_tail",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "cold_open_s": round(t_cold, 4),
+        "warm_open_s": round(t_warm, 4),
+        "first_fold_s": round(t_first, 4),
+        "build_s": round(t_build, 1),
+        "opened_from_checkpoint": bool(warm_hit),
+        "checkpoint_fallback_reason": fallback,
+        "byte_identical": bool(equal),
+        "checkpoint_bytes": ck_bytes,
+        "plane_reuse": {
+            k: v for k, v in plane_proof.items() if k != "obs"
+        },
+        "backend": dev.platform,
+    }
+    print(json.dumps(result))
+    # the bench exists to prove these — a run that silently fell back to
+    # a cold open or diverged must fail loudly (diagnostic JSON above is
+    # printed, but nothing lands in the evidence file)
+    if not (warm_hit and equal):
+        log(
+            f"FAILED: warm_hit={warm_hit} (fallback: {fallback}) "
+            f"byte_identical={equal} — refusing to record"
+        )
+        raise SystemExit(1)
+    if os.environ.get("BENCH_LOCAL_DISABLE") != "1" and (
+        dev.platform == "tpu" or os.environ.get("BENCH_LOCAL_ALL") == "1"
+    ):
+        _append_local({
+            **result,
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"),
+            "device_kind": dev.device_kind,
+            "host_cpus": os.cpu_count(),
+            "shape": {"N": N, "R": R, "E": E, "ops_per_file": OPF,
+                      "files": len(files), "tail_files": len(tail),
+                      "tail_ops": n_tail_ops, "total_ops": total_ops},
+            "obs_cold": obs_cold,
+            "obs_warm": obs_warm,
+            "obs_plane_reuse": plane_proof.get("obs"),
+        })
+
+
+async def _plane_reuse_rounds():
+    """Two compaction rounds in one process on a small dense-regime
+    workload: round 1 uploads the full state planes (counted in
+    h2d_bytes at issue), round 2 hits the accelerator's device-resident
+    plane cache — ~zero full-state re-upload (ISSUE-4 acceptance)."""
+    from crdt_enc_tpu.backends import (
+        IdentityCryptor, MemoryRemote, MemoryStorage, PlainKeyCryptor,
+    )
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.utils import trace
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    def opts(storage, accel=None):
+        return OpenOptions(
+            storage=storage, cryptor=IdentityCryptor(),
+            key_cryptor=PlainKeyCryptor(), adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1, create=True,
+            accelerator=accel if accel is not None else TpuAccelerator(),
+        )
+
+    remote = MemoryRemote()
+    reader = await Core.open(
+        opts(MemoryStorage(remote), TpuAccelerator(min_device_batch=1))
+    )
+    writer = await Core.open(opts(MemoryStorage(remote)))
+
+    async def write(n, tag):
+        for i in range(n):
+            await writer.apply_ops([writer.with_state(
+                lambda s: s.add_ctx(writer.actor_id, b"%s-%d" % (tag, i))
+            )])
+
+    rounds = {}
+    for rd in (1, 2):
+        await write(60, b"r%d" % rd)
+        trace.reset()
+        await reader.compact()
+        snap = trace.snapshot()
+        rounds[rd] = {
+            "h2d_bytes": snap["counters"].get("h2d_bytes", 0),
+            "obs": snap,
+        }
+    return {
+        "round1_h2d_bytes": rounds[1]["h2d_bytes"],
+        "round2_h2d_bytes": rounds[2]["h2d_bytes"],
+        "round2_full_state_reupload": rounds[2]["h2d_bytes"] > 0,
+        "obs": rounds[2]["obs"],
+    }
+
+
 def main():
     smoke = "--smoke" in sys.argv
     if "--e2e-streaming" in sys.argv:
         e2e_streaming(smoke)
+        return
+    if "--e2e-warm-open" in sys.argv:
+        e2e_warm_open(smoke)
         return
     N = int(os.environ.get("BENCH_OPS", 50_000 if smoke else 1_000_000))
     R = int(os.environ.get("BENCH_REPLICAS", 500 if smoke else 10_000))

@@ -58,6 +58,17 @@ def enable_compilation_cache(path: str | None = None) -> str:
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # jax initializes the cache module lazily at the FIRST compile and
+    # then latches: enabling a dir after any compile has happened would
+    # silently do nothing.  Reset so the new dir takes effect now.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - cache module reshuffles
+        pass
     return path
 
 

@@ -28,10 +28,12 @@ TPU kernels) — see crdt_enc_tpu/core/adapters.py and parallel/accel.py.
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import logging
 import uuid
 from dataclasses import dataclass, field
 
-from ..models import MVReg, VClock
+from ..models import MVReg, ORSet, VClock
 from ..utils.lockbox import LockBox
 from ..models.vclock import Actor, Dot
 from ..utils import VersionBytes, codec, trace
@@ -47,6 +49,12 @@ from .storage import Storage
 IO_CONCURRENCY = 16  # bounded pipeline width (reference lib.rs:452,512)
 BULK_MIN_FILES = 16  # below this the per-file asyncio path is cheaper
 BULK_STREAM_CHUNK = 16384  # files per decrypt-lookahead chunk (bulk ingest)
+
+# local fold-checkpoint payload formats (docs/checkpointing.md)
+CHECKPOINT_FMT_OBJ = 0  # adapter.state_to_obj (any CRDT type)
+CHECKPOINT_FMT_ORSET = 1  # ops/columnar.py orset_pack_checkpoint
+
+logger = logging.getLogger("crdt_enc_tpu.core")
 
 
 class CoreError(Exception):
@@ -151,6 +159,14 @@ class OpenOptions:
     current_data_version: bytes
     create: bool = False
     accelerator: object = field(default_factory=HostAccelerator)
+    # local fold checkpoints (docs/checkpointing.md): with ``checkpoint``
+    # on, compact() seals a warm-open resume point through the storage
+    # port's local-checkpoint slot and open() restores it after
+    # verification (falling back to the cold refold on any mismatch).
+    # ``checkpoint_on_read`` additionally reseals after every
+    # read_remote() — for pure-consumer replicas that never compact.
+    checkpoint: bool = True
+    checkpoint_on_read: bool = False
 
 
 async def open_sealed_blob(
@@ -176,6 +192,20 @@ async def open_sealed_blob(
     if supported_data_versions is not None:
         inner.ensure_versions(supported_data_versions)
     return codec.unpack(inner.content)
+
+
+def unpack_checkpoint_state(adapter, fmt: int, st):
+    """Decode a checkpoint's state payload — the ONE implementation of
+    the format dispatch (the core's warm open and ``tools/fsck
+    --verify-checkpoint`` both go through here, so a new format can
+    never be readable by one and 'unknown' to the other)."""
+    if fmt == CHECKPOINT_FMT_ORSET:
+        from ..ops.columnar import orset_unpack_checkpoint
+
+        return orset_unpack_checkpoint(st)
+    if fmt == CHECKPOINT_FMT_OBJ:
+        return adapter.state_from_obj(st)
+    raise CoreError(f"unknown checkpoint format {fmt!r}")
 
 
 class _MutData:
@@ -214,6 +244,13 @@ class Core:
         # Lock order: _keys_lock → _meta_lock (never the reverse).
         self._keys_lock = asyncio.Lock()
         self._local_meta: LocalMeta | None = None
+        self._checkpoint_enabled = opts.checkpoint
+        self._checkpoint_on_read = opts.checkpoint_on_read
+        self._checkpoint_sig: tuple | None = None  # last sealed resume point
+        # warm-open observability: did open() restore a checkpoint, and
+        # if not (one existed but was rejected), why
+        self.opened_from_checkpoint = False
+        self.checkpoint_fallback_reason: str | None = None
 
     # ------------------------------------------------------------------ open
     @classmethod
@@ -253,6 +290,8 @@ class Core:
                 raise MissingKeyError(
                     "key cryptor did not install a latest key at open"
                 )
+        if opts.checkpoint:
+            await core._open_from_checkpoint()
         return core
 
     # -------------------------------------------------------------- identity
@@ -308,6 +347,166 @@ class Core:
         key change.  Returns the new key.
         """
         return await self._install_new_key()
+
+    # ------------------------------------------------------ fold checkpoints
+    def _checkpoint_fingerprint(self) -> dict:
+        """The warm-open validity seal (docs/checkpointing.md): a
+        checkpoint is only installable into a replica whose adapter,
+        identity, data version, key generation (latest data-key id —
+        rotation invalidates) and converged remote metadata all match
+        the sealing replica's.  The meta hash is over the canonical
+        packed RemoteMeta, so any plugin-config or key-register change
+        on the remote (including a wiped-and-recreated remote) forces a
+        cold refold."""
+        d = self._data
+        latest = d.keys.latest_key()
+        return {
+            b"a": self.adapter.name,
+            b"id": self.actor_id,
+            b"dv": self.current_data_version,
+            b"key": latest.id if latest is not None else b"",
+            b"meta": hashlib.sha3_256(
+                codec.pack(d.remote_meta.to_obj())
+            ).digest(),
+        }
+
+    def _pack_checkpoint_state(self):
+        """(fmt, obj) for the current state: the packed-columnar ORSet
+        encoding when it applies losslessly, else the adapter's generic
+        object form (identical to the compacted-snapshot payload)."""
+        state = self._data.state
+        if type(state) is ORSet:
+            from ..ops.columnar import orset_pack_checkpoint
+
+            obj = orset_pack_checkpoint(state)
+            if obj is not None:
+                return CHECKPOINT_FMT_ORSET, obj
+        return CHECKPOINT_FMT_OBJ, self.adapter.state_to_obj(state)
+
+    def _unpack_checkpoint_state(self, fmt: int, st):
+        return unpack_checkpoint_state(self.adapter, fmt, st)
+
+    async def save_checkpoint(self) -> bool:
+        """Seal the materialized state + ingest cursor + read-states set
+        as this replica's local warm-open checkpoint (sealed with the
+        normal data-key cryptor, stored through the storage port's
+        atomic local-checkpoint slot).  A later ``open`` restores it and
+        ingests only op tails past the cursor — state-based CRDTs need
+        no op log to resume (arXiv:1905.08733), so the persisted state +
+        cursor is a complete, safe resume point.  Returns False when
+        checkpointing is disabled on this core."""
+        if not self._checkpoint_enabled:
+            return False
+        with trace.span("checkpoint.save"):
+            # sync section: every mutable input is materialized before
+            # the first await, so a concurrent apply cannot tear the
+            # (state, cursor) pair
+            d = self._data
+            fmt, st = self._pack_checkpoint_state()
+            sig = (
+                dict(d.next_op_versions.counters), frozenset(d.read_states)
+            )
+            payload = {
+                b"fmt": fmt,
+                b"state": st,
+                b"cursor": d.next_op_versions.to_obj(),
+                b"rs": sorted(d.read_states),
+                b"fp": self._checkpoint_fingerprint(),
+            }
+            blob = await self._seal(payload)
+            await self.storage.store_local_checkpoint(blob)
+            self._checkpoint_sig = sig  # only a DURABLE seal gates skips
+            trace.add("checkpoint_bytes", len(blob))
+        return True
+
+    async def _checkpoint_fallback(self, reason: str) -> bool:
+        """Record WHY a present checkpoint was rejected (traced counter +
+        reason attribute), drop the rejected blob (a cache that failed
+        verification is dead weight every future open would re-parse —
+        the next save reseals a valid one), and signal the cold path."""
+        self.checkpoint_fallback_reason = reason
+        trace.add("checkpoint_fallbacks", 1)
+        logger.info(
+            "local checkpoint rejected (%s); opening cold", reason
+        )
+        await self.storage.remove_local_checkpoint()
+        return False
+
+    @staticmethod
+    def _fp_bytes(v) -> bytes | None:
+        return bytes(v) if isinstance(v, (bytes, bytearray, memoryview)) else None
+
+    async def _open_from_checkpoint(self) -> bool:
+        """Restore the local fold checkpoint if one exists and verifies:
+        decrypts under a known key, fingerprint current (adapter /
+        actor / data version / key generation / remote-meta hash), and
+        the cursor still traceable against the remote listing.  Any
+        torn file, decrypt failure, or mismatch falls back to the cold
+        refold with the reason traced — a checkpoint is a cache, never
+        a source of truth."""
+        raw = await self.storage.load_local_checkpoint()
+        if raw is None:
+            return False
+        with trace.span("checkpoint.load"):
+            try:
+                obj = await self._open_sealed(raw)
+            except Exception:
+                logger.debug("checkpoint undecryptable", exc_info=True)
+                return await self._checkpoint_fallback("unreadable")
+            with trace.span("checkpoint.verify"):
+                try:
+                    fp = dict(obj[b"fp"])
+                    fmt = int(obj[b"fmt"])
+                    cursor = VClock.from_obj(obj[b"cursor"])
+                    read_states = {str(n) for n in obj[b"rs"]}
+                except Exception:
+                    logger.debug("checkpoint malformed", exc_info=True)
+                    return await self._checkpoint_fallback("malformed")
+                expected = self._checkpoint_fingerprint()
+                for field_key, reason in (
+                    (b"a", "adapter"),
+                    (b"id", "actor"),
+                    (b"dv", "data_version"),
+                    (b"key", "key_rotation"),
+                    (b"meta", "remote_meta"),
+                ):
+                    if self._fp_bytes(fp.get(field_key)) != expected[field_key]:
+                        return await self._checkpoint_fallback(reason)
+                # cursor ⊆ remote listing: every actor the checkpoint
+                # claims folded must still have its op log listed, OR a
+                # state snapshot must exist (compaction legitimately GCs
+                # op logs into snapshots — whether it is one this
+                # checkpoint folded or a superseding unread one, the
+                # CvRDT merge of read_remote converges either way).  A
+                # remote with neither — no cursor actors, no snapshots —
+                # is not the remote this checkpoint came from.
+                if cursor.counters:
+                    op_actors = set(await self.storage.list_op_actors())
+                    covered = set(cursor.counters) <= op_actors or bool(
+                        await self.storage.list_state_names()
+                    )
+                    if not covered:
+                        return await self._checkpoint_fallback("cursor")
+                try:
+                    state = self._unpack_checkpoint_state(fmt, obj[b"state"])
+                except Exception:
+                    logger.debug(
+                        "checkpoint state undecodable", exc_info=True
+                    )
+                    return await self._checkpoint_fallback("malformed")
+            # sync install section: the resume point becomes the live
+            # replica state; read_remote ingests only past the cursor
+            d = self._data
+            d.state = state
+            d.next_op_versions = cursor
+            d.read_states = read_states
+            # the installed resume point IS the last sealed one: a quiet
+            # first poll under checkpoint_on_read must not reseal it
+            self._checkpoint_sig = (
+                dict(cursor.counters), frozenset(read_states)
+            )
+        self.opened_from_checkpoint = True
+        return True
 
     # ------------------------------------------------------- wire (3 layers)
     def _latest_key(self) -> Key:
@@ -400,6 +599,17 @@ class Core:
         await self._read_remote_meta()
         await self._read_remote_states()
         await self._read_remote_ops()
+        if self._checkpoint_on_read and self._checkpoint_enabled:
+            # pure-consumer replicas (no compaction rights) reseal their
+            # resume point after every ingest — but not after a no-op
+            # poll (same cursor + read-states as the last seal): a quiet
+            # remote must not cost a multi-MB re-pack + fsync per poll
+            d = self._data
+            sig = (
+                dict(d.next_op_versions.counters), frozenset(d.read_states)
+            )
+            if sig != self._checkpoint_sig:
+                await self.save_checkpoint()
 
     async def _read_remote_states(self) -> None:
         with trace.span("states.list"):
@@ -553,8 +763,11 @@ class Core:
         open_session = getattr(self.accel, "open_fold_session", None)
         if open_session is None:
             return False
-        session = open_session(self._data.state, actors_hint=actors)
-        if session is None:
+        # cheap type gate BEFORE any pipeline machinery: a session-less
+        # CRDT type must not pay the producer's storage scan (incl. the
+        # per-actor tail probe) only to cancel it and re-read legacily
+        can_open = getattr(self.accel, "can_open_fold_session", None)
+        if can_open is not None and not can_open(self._data.state):
             return False
 
         q: asyncio.Queue = asyncio.Queue(maxsize=2)
@@ -604,6 +817,25 @@ class Core:
         from ..parallel.session import SessionDeclined
 
         producer = asyncio.create_task(produce())
+        # one tick steps the producer into its first storage scan (a
+        # worker thread), so the session's sync state-vocabulary walk
+        # below — the other big fixed cost of a tail ingest — runs
+        # CONCURRENTLY with the per-actor tail probe instead of after it
+        await asyncio.sleep(0)
+        try:
+            session = open_session(self._data.state, actors_hint=actors)
+        except BaseException:
+            producer.cancel()
+            raise
+        if session is None:
+            # no chunked path for this CRDT type: the legacy flow
+            # re-lists and re-loads (reads are idempotent)
+            producer.cancel()
+            try:
+                await producer
+            except (asyncio.CancelledError, Exception):
+                pass
+            return False
         session_done = False
         python_mode = False
         pending: list[tuple[list, list]] = []  # buffered below BULK_MIN_FILES
@@ -915,6 +1147,10 @@ class Core:
         # sync bookkeeping section
         d.read_states.difference_update(states_to_remove)
         d.read_states.add(name)
+        if self._checkpoint_enabled:
+            # the freshly compacted state is the ideal warm-open resume
+            # point: everything folded, op logs GC'd to the cursor
+            await self.save_checkpoint()
         # local ops are now folded into the snapshot; reset the producer
         # cursor bookkeeping is unnecessary — versions only grow.
         # run-scoped metrics sink (CRDT_OBS_SINK / obs.sink.configure):

@@ -76,6 +76,11 @@ class ORSet:
     clock: VClock = field(default_factory=VClock)
     entries: dict = field(default_factory=dict)  # member -> {actor: counter}
     deferred: dict = field(default_factory=dict)  # member -> {actor: counter}
+    # mutation epoch: bumped by every mutating method (and by the
+    # accelerator's plane writebacks) so device-resident plane caches can
+    # key their validity on it (parallel/accel.py) — a cache entry whose
+    # recorded epoch no longer matches has missed a host mutation
+    _mut: int = field(default=0, compare=False, repr=False)
 
     # -- op construction (local replica) -----------------------------------
     def add_ctx(self, actor: Actor, member: Member) -> AddOp:
@@ -87,6 +92,7 @@ class ORSet:
 
     # -- CmRDT apply -------------------------------------------------------
     def apply(self, op) -> None:
+        self._mut += 1
         if isinstance(op, (list, tuple)):
             op = op_from_obj(op)
         if isinstance(op, AddOp):
@@ -123,6 +129,7 @@ class ORSet:
 
     # -- CvRDT merge -------------------------------------------------------
     def merge(self, other: "ORSet") -> None:
+        self._mut += 1
         members = set(self.entries) | set(other.entries)
         new_entries: dict = {}
         for e in members:
@@ -158,6 +165,7 @@ class ORSet:
         """ResetRemove (for causal-Map children): forget every dot and
         horizon the removed context observed — entries, deferred removes,
         and the clock itself all drop state ≤ ctx per actor."""
+        self._mut += 1
         for m in list(self.entries):
             entry = self.entries[m]
             for r in [r for r, c in entry.items() if c <= ctx.get(r)]:

@@ -114,6 +114,11 @@ def _bind_state(lib) -> None:
     lib.orset_fresh_fold.restype = ctypes.c_int
     lib.dense_clock_dict.argtypes = [i32p, ctypes.c_int64, ctypes.py_object]
     lib.dense_clock_dict.restype = ctypes.py_object
+    lib.grouped_rows_dicts.argtypes = [
+        i32p, i32p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.py_object, ctypes.py_object, ctypes.py_object,
+    ]
+    lib.grouped_rows_dicts.restype = ctypes.c_int
     lib.bytes_lens_join.argtypes = [
         ctypes.py_object, u64p, u8p, ctypes.c_int64, ctypes.c_int64
     ]
@@ -185,6 +190,10 @@ def _bind(lib) -> None:
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, u8p
     ]
     lib.read_op_files.restype = ctypes.c_int64
+    lib.probe_op_files.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, u8p
+    ]
+    lib.probe_op_files.restype = ctypes.c_int64
     # (the two-pass count+decode batch protocol still exists in C —
     # orset_count_rows_batch / orset_decode_batch[_h] — but the Python
     # span decoder moved to the single-pass grow/take protocol below, so

@@ -37,8 +37,15 @@ _recompiles_explicit = False  # an operator choice must stick
 
 # The one duration event XLA emits exactly once per backend compilation
 # (jaxpr tracing and MLIR lowering emit siblings; counting those would
-# double-book a single cache miss).
+# double-book a single cache miss).  NOTE: with a persistent compilation
+# cache configured (CRDT_JIT_CACHE / enable_compilation_cache), jax
+# emits this event around the compile-or-retrieve step, so a disk-cache
+# RETRIEVAL also counts as a "compile" here — the cache_hits/cache_misses
+# events below split the two: ``jax_cache_misses`` is the count of real
+# XLA compiles, ``jax_cache_hits`` the count served from disk.
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 _mem_supported: bool | None = None  # probed once; None = not yet probed
 
@@ -47,6 +54,15 @@ def _on_duration_event(event: str, duration: float, **kwargs) -> None:
     if _recompiles_enabled and event == _COMPILE_EVENT:
         record.add("jax_compiles", 1)
         record.observe("jax.compile", duration)
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if not _recompiles_enabled:
+        return
+    if event == _CACHE_HIT_EVENT:
+        record.add("jax_cache_hits", 1)
+    elif event == _CACHE_MISS_EVENT:
+        record.add("jax_cache_misses", 1)
 
 
 def track_recompiles(on: bool = True) -> None:
@@ -85,6 +101,7 @@ def _set_recompiles(on: bool) -> None:
             jax.monitoring.register_event_duration_secs_listener(
                 _on_duration_event
             )
+            jax.monitoring.register_event_listener(_on_event)
             _listener_installed = True
 
 

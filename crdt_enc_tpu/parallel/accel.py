@@ -35,6 +35,32 @@ def _bucket(n: int, floor: int = 8) -> int:
     return b
 
 
+class _OrsetPlaneCache:
+    """Device-resident ORSet state planes carried between folds.
+
+    After a dense fold writes its result back to the sparse host state,
+    the very planes it computed — already on device, already normalized,
+    byte-equal to the state — are kept here so the NEXT fold on the same
+    un-mutated state skips the state→planes walk and the full-state H2D
+    re-upload (repeated ``read_remote``/``compact`` rounds in one
+    process).  Validity is (object identity via weakref) × (the state's
+    ``_mut`` mutation epoch recorded at writeback): any host mutation —
+    per-op apply, CvRDT merge, another accelerator path's writeback —
+    bumps the epoch and the entry silently expires.  The vocabularies
+    are the fold vocabs of the caching round; later batches remap onto
+    them (value-collision-guarded, exactly like the fold sessions)."""
+
+    __slots__ = ("ref", "token", "members", "replicas", "planes", "canon")
+
+    def __init__(self, ref, token, members, replicas, planes, canon):
+        self.ref = ref
+        self.token = token
+        self.members = members
+        self.replicas = replicas
+        self.planes = planes  # (clock, add, rm) device arrays
+        self.canon = canon  # member slot -> canonical packed bytes
+
+
 class TpuAccelerator(HostAccelerator):
     """Accelerates ORSet / G-Counter / PN-Counter / LWW-Map folds and
     ORSet / MVReg merges; anything else (EmptyCrdt, custom types — and
@@ -55,9 +81,34 @@ class TpuAccelerator(HostAccelerator):
         map_fold_impl: str | None = None,
         sharded_stream: bool | None = None,
         stream_producers: int = 0,
+        plane_reuse: bool | None = None,
     ):
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # device-resident plane reuse across fold rounds (None = auto-on;
+        # CRDT_PLANE_REUSE=0 opts out).  Single-device only: the sharded
+        # fold keeps planes mp-distributed and re-builds per round.
+        if plane_reuse is None:
+            plane_reuse = os.environ.get(
+                "CRDT_PLANE_REUSE", ""
+            ).strip().lower() not in ("0", "false", "off", "no", "disabled")
+        self.plane_reuse = bool(plane_reuse)
+        self._plane_cache: _OrsetPlaneCache | None = None
+        # persistent XLA compilation cache (CRDT_JIT_CACHE=<dir> or =1
+        # for the default cache dir): short-lived compaction processes
+        # stop re-paying first-compile cost for shapes any prior process
+        # on this host already compiled
+        jit_cache = os.environ.get("CRDT_JIT_CACHE", "").strip()
+        if jit_cache and jit_cache.lower() not in (
+            "0", "false", "off", "no", "disabled",
+        ):
+            import crdt_enc_tpu
+
+            crdt_enc_tpu.enable_compilation_cache(
+                None
+                if jit_cache.lower() in ("1", "true", "on", "yes", "enabled")
+                else jit_cache
+            )
         # mesh-sharded streaming fold (parallel/session.py
         # _device_feed_sharded): None = auto — ON whenever the mesh is
         # active, so a pod compaction streams through the SPMD kernels
@@ -149,15 +200,128 @@ class TpuAccelerator(HostAccelerator):
             self.SPARSE_CELLS_PER_ROW * max(n_rows, 1)
         )
 
+    def _plane_cache_for(self, state: ORSet) -> _OrsetPlaneCache | None:
+        """The live cache entry for ``state``, or None (no entry, entry
+        for another object, or the state mutated since it was filled)."""
+        if not self.plane_reuse or self._mesh_active():
+            return None
+        c = self._plane_cache
+        if c is None or c.ref() is not state:
+            return None
+        if c.token != getattr(state, "_mut", None):
+            self._plane_cache = None  # stale: free the device planes
+            return None
+        return c
+
+    @staticmethod
+    def _remap_to_cache(cache: _OrsetPlaneCache, member, actor,
+                        members, replicas):
+        """Remap batch columns from their batch-local vocabs onto the
+        cache's vocabs (growing them), or None when a member value
+        collision (1 == True, 0.0 == -0.0) makes the dense planes
+        unrepresentable — the caller then takes the uncached path."""
+        from ..utils import codec
+
+        if (len(member) and int(np.max(member)) >= len(members.items)) or (
+            len(actor) and int(np.max(actor)) >= len(replicas.items)
+        ):
+            return None  # sentinel/padded columns: not plain vocab indices
+        mt = np.empty(len(members.items), np.int32)
+        canon = cache.canon
+        for i, obj in enumerate(members.items):
+            gid = cache.members.intern(obj)
+            pk = codec.pack(obj)
+            prev = canon.get(gid)
+            if prev is None:
+                stored = cache.members.items[gid]
+                prev = pk if stored is obj else codec.pack(stored)
+                canon[gid] = prev
+            if prev != pk:
+                return None
+            mt[i] = gid
+        rt = np.empty(len(replicas.items), np.int32)
+        for i, a in enumerate(replicas.items):
+            rt[i] = cache.replicas.intern(a)
+        member = mt[member] if len(member) else np.asarray(member, np.int32)
+        actor = rt[actor] if len(actor) else np.asarray(actor, np.int32)
+        return member, actor
+
+    @staticmethod
+    def _cached_planes_padded(cache: _OrsetPlaneCache, E: int, R: int):
+        """The cached device planes grown (on device — no host transfer)
+        to the post-remap vocab sizes."""
+        import jax.numpy as jnp
+
+        clock, add, rm = cache.planes
+        E0, R0 = add.shape
+        if R > R0:
+            clock = jnp.pad(clock, (0, R - R0))
+            add = jnp.pad(add, ((0, 0), (0, R - R0)))
+            rm = jnp.pad(rm, ((0, 0), (0, R - R0)))
+        if E > E0:
+            add = jnp.pad(add, ((0, E - E0), (0, 0)))
+            rm = jnp.pad(rm, ((0, E - E0), (0, 0)))
+        return clock, add, rm
+
+    def _install_plane_cache(
+        self, state: ORSet, members, replicas, dev_planes, canon
+    ) -> None:
+        """Record the fold's device planes as the state's resume planes.
+        The writeback bump happens HERE so the recorded token is the
+        post-writeback epoch.  The weakref finalizer drops the entry the
+        moment the state dies — plane-sized device buffers must not
+        outlive the replica they cache (the accelerator itself is held
+        weakly in the callback, so nothing keeps anything alive)."""
+        state._mut += 1
+        if not self.plane_reuse or self._mesh_active():
+            return
+        import weakref
+
+        accel_ref = weakref.ref(self)
+
+        def _drop(dead_ref):
+            accel = accel_ref()
+            if accel is not None:
+                c = accel._plane_cache
+                if c is not None and c.ref is dead_ref:
+                    accel._plane_cache = None
+
+        self._plane_cache = _OrsetPlaneCache(
+            weakref.ref(state, _drop), state._mut, members, replicas,
+            dev_planes, canon if canon is not None else {},
+        )
+
+    def _note_orset_writeback(self, state: ORSet) -> None:
+        """A non-caching path rewrote ``state``: bump its epoch and drop
+        any device planes held for it."""
+        state._mut += 1
+        c = self._plane_cache
+        if c is not None and c.ref() is state:
+            self._plane_cache = None
+
     def _fold_orset_columns(
         self, state: ORSet, kind, member, actor, counter, members, replicas
     ) -> ORSet:
         """Shared tail: state → planes, pad, jit fold, planes → state.
         Sparse batches over huge vocabularies take the sorted-COO kernel
-        instead — same semantics, no dense plane materialization."""
+        instead — same semantics, no dense plane materialization.  With
+        ``plane_reuse`` on and an unmutated state, the dense branch
+        reuses the previous round's device-resident planes instead of
+        re-walking the state and re-issuing the full-state H2D upload."""
         n_rows = len(kind)
-        with trace.span("fold.vocab"):
-            K.orset_scan_vocab(state, members, replicas)
+        cache = self._plane_cache_for(state)
+        if cache is not None:
+            remapped = self._remap_to_cache(
+                cache, member, actor, members, replicas
+            )
+            if remapped is None:
+                cache = None
+            else:
+                member, actor = remapped
+                members, replicas = cache.members, cache.replicas
+        if cache is None:
+            with trace.span("fold.vocab"):
+                K.orset_scan_vocab(state, members, replicas)
         E, R = len(members), len(replicas)
         if E == 0 or R == 0:
             return state
@@ -171,23 +335,38 @@ class TpuAccelerator(HostAccelerator):
             )
         if self._use_sparse(E, R, n_rows):
             if self.sparse_device and 2 * E * R < 2**31:
-                return self._fold_orset_coo_device(
+                folded = self._fold_orset_coo_device(
                     state, kind, member, actor, counter, members, replicas
                 )
-            # vectorized host fold: in the N ≪ E·R regime the work is one
-            # sort, where numpy beats the TPU's bitonic sort ~25x and no
-            # dense planes exist to ship (see orset_fold_sparse_host docs).
-            # No bucket padding — that exists only to bound jit
-            # recompilation, and this path never compiles anything.
-            return K.orset_fold_sparse_host(
-                state, kind, member, actor, counter, members, replicas
-            )
-        with trace.span("fold.planes"):
-            clock0, add0, rm0 = K.orset_state_to_planes(
-                state, members, replicas, scanned=True
-            )
+            else:
+                # vectorized host fold: in the N ≪ E·R regime the work is
+                # one sort, where numpy beats the TPU's bitonic sort ~25x
+                # and no dense planes exist to ship (see
+                # orset_fold_sparse_host docs).  No bucket padding — that
+                # exists only to bound jit recompilation, and this path
+                # never compiles anything.
+                folded = K.orset_fold_sparse_host(
+                    state, kind, member, actor, counter, members, replicas
+                )
+            c = self._plane_cache
+            if c is not None and c.ref() is state:
+                self._plane_cache = None  # sparse writeback: planes stale
+            return folded
+        if cache is not None:
+            clock0, add0, rm0 = self._cached_planes_padded(cache, E, R)
+        else:
+            with trace.span("fold.planes"):
+                clock0, add0, rm0 = K.orset_state_to_planes(
+                    state, members, replicas, scanned=True
+                )
         with trace.span("fold.device"):
             if n_rows > self.STREAM_CHUNK_ROWS:
+                if cache is not None:
+                    # the blockwise stream stages planes from host (its
+                    # own H2D rides under the first fold) — pull once
+                    clock0, add0, rm0 = (
+                        np.asarray(x) for x in (clock0, add0, rm0)
+                    )
                 # blockwise fold with donated plane buffers: bounded device
                 # memory for arbitrarily large ingests (ops/stream.py).
                 # Chunks route through the Pallas MXU fold when eligible —
@@ -206,7 +385,7 @@ class TpuAccelerator(HostAccelerator):
                 # recycled pool buffer and its H2D transfer rides under
                 # chunk k's fold (ops/stream.py fold_chunks_overlapped)
                 pool = ChunkPool(self.STREAM_CHUNK_ROWS, depth=2)
-                clock, add, rm = K.orset_fold_stream(
+                dev_planes = K.orset_fold_stream(
                     clock0, add0, rm0,
                     K.iter_orset_chunks(
                         kind, member, actor, counter,
@@ -215,10 +394,18 @@ class TpuAccelerator(HostAccelerator):
                     num_members=E, num_replicas=R, pool=pool, **stream_kw,
                 )
             else:
+                if cache is None:
+                    # the full-state upload the plane cache exists to
+                    # elide — counted at issue, like the streaming paths
+                    # (the stream branch above counts its own)
+                    trace.add(
+                        "h2d_bytes",
+                        clock0.nbytes + add0.nbytes + rm0.nbytes,
+                    )
                 cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
                 K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
                 fold = self._pick_dense_fold(cols, E, R)
-                clock, add, rm = fold(
+                dev_planes = fold(
                     clock0,
                     add0,
                     rm0,
@@ -227,15 +414,19 @@ class TpuAccelerator(HostAccelerator):
                     cols.actor,
                     cols.counter,
                 )
-            clock, add, rm = (
-                np.asarray(clock), np.asarray(add), np.asarray(rm),
-            )
+            clock, add, rm = (np.asarray(x) for x in dev_planes)
         obs_runtime.sample_device_memory()  # fold boundary
         with trace.span("fold.writeback"):
             folded = K.orset_planes_to_state(clock, add, rm, members, replicas)
         state.clock = folded.clock
         state.entries = folded.entries
         state.deferred = folded.deferred
+        # the planes just computed ARE the new state, already on device:
+        # keep them for the next round (epoch recorded post-writeback)
+        self._install_plane_cache(
+            state, members, replicas, dev_planes,
+            cache.canon if cache is not None else None,
+        )
         return state
 
     @staticmethod
@@ -393,9 +584,18 @@ class TpuAccelerator(HostAccelerator):
         state.clock = folded.clock
         state.entries = folded.entries
         state.deferred = folded.deferred
+        self._note_orset_writeback(state)
         return state
 
     # ------------------------------------------------------- fold sessions
+    def can_open_fold_session(self, state) -> bool:
+        """Cheap predicate twin of :meth:`open_fold_session` (no session
+        construction): the core checks it before spinning up pipeline
+        machinery whose cost only pays off when a session exists."""
+        from .session import session_supported
+
+        return session_supported(state)
+
     def open_fold_session(self, state, actors_hint=()):
         """A chunked fold session for the core's pipelined bulk ingest
         (parallel/session.py), or None for CRDT types without a columnar
@@ -1078,6 +1278,7 @@ class TpuAccelerator(HostAccelerator):
         state.clock = merged.clock
         state.entries = merged.entries
         state.deferred = merged.deferred
+        self._note_orset_writeback(state)
         return state
 
     def _merge_orsets(self, state: ORSet, others: list) -> ORSet:
@@ -1101,6 +1302,7 @@ class TpuAccelerator(HostAccelerator):
         state.clock = merged.clock
         state.entries = merged.entries
         state.deferred = merged.deferred
+        self._note_orset_writeback(state)
         return state
 
 

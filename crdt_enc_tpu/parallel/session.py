@@ -109,16 +109,24 @@ class OrsetFoldSession:
     def __init__(self, accel, state: ORSet, actors_hint=()):
         self.accel = accel
         self.state = state
+        # one pass over the state builds BOTH vocabularies: actors via
+        # C-level set.update per entry dict, members in first-appearance
+        # order (entries, then deferred) — a per-dot intern walk here
+        # cost ~0.5s of every warm-open tail ingest at 1M-dot states
         actor_set = set(actors_hint)
         actor_set.update(state.clock.counters)
-        for entry in state.entries.values():
+        member_list = []
+        for m, entry in state.entries.items():
+            member_list.append(m)
             actor_set.update(entry)
-        for dfr in state.deferred.values():
+        for m, dfr in state.deferred.items():
+            member_list.append(m)
             actor_set.update(dfr)
         self.actors_sorted = sorted(actor_set)
         self.replicas = K.Vocab(self.actors_sorted)
         self.members = K.Vocab()
-        K.orset_scan_vocab(state, self.members, self.replicas)
+        for m in member_list:
+            self.members.intern(m)
         self._state_members = len(self.members)
         self.R = len(self.replicas)
         # the kernel's stale-add mask is evaluated against the clock as of
@@ -273,11 +281,17 @@ class OrsetFoldSession:
                     self.accel.mesh, self._d_E, self.R
                 )
             else:
-                trace.add("h2d_bytes", 4 * (self.R + 2 * self._d_E * self.R))
+                # the zero accumulator planes materialize ON device (an
+                # XLA fill — no host buffer exists, so there is no
+                # full-plane device_put to issue or count): repeated
+                # read_remote rounds in one process stop re-uploading
+                # plane-sized zero buffers (ISSUE-4 plane reuse)
+                import jax.numpy as jnp
+
                 self._d_planes = (
-                    jax.device_put(np.zeros(max(self.R, 1), np.int32)),
-                    jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
-                    jax.device_put(np.zeros((self._d_E, self.R), np.int32)),
+                    jnp.zeros(max(self.R, 1), jnp.int32),
+                    jnp.zeros((self._d_E, self.R), jnp.int32),
+                    jnp.zeros((self._d_E, self.R), jnp.int32),
                 )
             for cols in self._buffered:
                 self._device_feed(*cols)
@@ -584,6 +598,13 @@ class OrsetFoldSession:
         state.clock = folded.clock
         state.entries = folded.entries
         state.deferred = folded.deferred
+        # bump the mutation epoch (and drop the accelerator's device
+        # plane cache if it holds this state) — the combine ran on host
+        note = getattr(self.accel, "_note_orset_writeback", None)
+        if note is not None:
+            note(state)
+        else:
+            state._mut += 1
         return state
 
     @staticmethod
@@ -826,15 +847,26 @@ class MapFoldSession:
         return state
 
 
+def session_supported(state) -> bool:
+    """Cheap type predicate for :func:`open_fold_session` — True iff a
+    chunked columnar session exists for ``state``'s type.  Costs one
+    isinstance chain, no session construction (whose state scans are the
+    expensive part) — callers use it to decide whether to spin up
+    pipeline machinery at all."""
+    from ..models.crdtmap import CrdtMap
+
+    if isinstance(state, (ORSet, GCounter, PNCounter)):
+        return True
+    return isinstance(state, CrdtMap) and state.child == b"orset"
+
+
 def open_fold_session(accel, state, actors_hint=()):
     """A fold session for ``state``, or None when no chunked columnar path
     exists for its type (the caller folds chunks through the per-op path)."""
-    from ..models.crdtmap import CrdtMap
-
+    if not session_supported(state):
+        return None
     if isinstance(state, ORSet):
         return OrsetFoldSession(accel, state, actors_hint)
     if isinstance(state, (GCounter, PNCounter)):
         return CounterFoldSession(accel, state, actors_hint)
-    if isinstance(state, CrdtMap) and state.child == b"orset":
-        return MapFoldSession(accel, state, actors_hint)
-    return None
+    return MapFoldSession(accel, state, actors_hint)

@@ -66,6 +66,23 @@ class FsckReport:
         )
 
 
+class _KeyCollector:
+    """Just enough CoreSubHandle surface for a key cryptor — collects the
+    decoded key set; never writes the remote.  Shared by ``fsck_remote``
+    and ``verify_checkpoint``."""
+
+    actor_id = b"\x00" * 16
+
+    def __init__(self):
+        self.keys = Keys()
+
+    def set_keys(self, keys):
+        self.keys = keys
+
+    async def set_remote_meta_key_cryptor(self, reg):
+        pass  # read-only: never write the remote
+
+
 async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> FsckReport:
     """Verify one remote.  ``deep=True`` additionally decrypts every state
     and op file (auth check) and parses the cleartext framing; ``False``
@@ -77,19 +94,7 @@ async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> Fs
     """
     report = FsckReport()
 
-    class _Collector:
-        """Just enough CoreSubHandle surface for a key cryptor."""
-
-        keys = Keys()
-        actor_id = b"\x00" * 16
-
-        def set_keys(self, keys):
-            self.keys = keys
-
-        async def set_remote_meta_key_cryptor(self, reg):
-            pass  # read-only: never write the remote
-
-    collector = _Collector()
+    collector = _KeyCollector()
     await key_cryptor.init(collector)
 
     # ---- meta family -----------------------------------------------------
@@ -222,6 +227,157 @@ async def _deep_check_ops(report, open_sealed, hexa: str, files: list) -> None:
             report.add("error", "ops", f"{hexa}:{version}", f"{e}")
 
 
+async def verify_checkpoint(
+    local_storage, storage, cryptor, key_cryptor, *, adapter=None
+) -> FsckReport:
+    """Verify a replica's local fold checkpoint against its remote: load
+    and decrypt the checkpoint, then REFOLD the remote (state snapshots
+    whose cursors it covers, plus op files up to the checkpoint cursor —
+    the same ingestion order a cold open runs) and byte-compare the two
+    canonical serializations.  Divergence is an error row (non-zero CLI
+    exit); a remote whose op logs no longer reach the cursor reports the
+    refold as unverifiable (warn) rather than passing silently.
+
+    ``adapter`` decodes generic-format checkpoints and replayed ops
+    (default: the OR-Set adapter)."""
+    from ..core.adapters import orset_adapter
+    from ..core.core import open_sealed_blob, unpack_checkpoint_state
+    from ..models.vclock import VClock
+
+    if adapter is None:
+        adapter = orset_adapter()
+    report = FsckReport()
+    raw = await local_storage.load_local_checkpoint()
+    if raw is None:
+        report.add(
+            "warn", "checkpoint", "local", "no local checkpoint to verify"
+        )
+        return report
+
+    # keys from the remote's converged metadata, exactly as a replica
+    # would read them (the same collector stub fsck_remote uses)
+    collector = _KeyCollector()
+    await key_cryptor.init(collector)
+    meta = RemoteMeta()
+    names = await storage.list_remote_meta_names()
+    for name, blob in await storage.load_remote_metas(names):
+        try:
+            vb = VersionBytes.deserialize(blob).ensure_versions(
+                SUPPORTED_CONTAINER_VERSIONS
+            )
+            meta.merge(RemoteMeta.from_obj(codec.unpack(vb.content)))
+        except Exception as e:
+            report.add("error", "meta", name, f"malformed: {e}")
+    try:
+        await key_cryptor.set_remote_meta(meta.key_cryptor)
+    except Exception as e:
+        report.add(
+            "error", "keys", "register", f"key metadata does not decode: {e}"
+        )
+        return report
+    keys = collector.keys
+
+    async def open_sealed(blob: bytes):
+        return await open_sealed_blob(keys, cryptor, blob)
+
+    with trace.span("checkpoint.verify"):
+        try:
+            obj = await open_sealed(raw)
+            fmt = int(obj[b"fmt"])
+            cursor = VClock.from_obj(obj[b"cursor"])
+            ck_state = unpack_checkpoint_state(adapter, fmt, obj[b"state"])
+        except Exception as e:
+            report.add("error", "checkpoint", "local", f"unreadable: {e}")
+            return report
+
+        refold = adapter.new()
+        folded_cursor = VClock()
+        state_names = await storage.list_state_names()
+        for name, blob in sorted(await storage.load_states(state_names)):
+            try:
+                sobj = await open_sealed(blob)
+                sc = VClock.from_obj(sobj[1])
+            except Exception as e:
+                report.add("error", "states", name, f"{e}")
+                continue
+            if any(c > cursor.get(a) for a, c in sc.counters.items()):
+                report.add(
+                    "warn", "checkpoint", name,
+                    "snapshot exceeds the checkpoint cursor "
+                    "(a later compaction); skipped from the refold",
+                )
+                continue
+            refold.merge(adapter.state_from_obj(sobj[0]))
+            folded_cursor.merge(sc)
+            report.state_files += 1
+        from contextlib import aclosing
+
+        unverifiable = []
+        for actor in sorted(cursor.counters):
+            last = cursor.get(actor)
+            v = folded_cursor.get(actor) + 1
+            # chunked read, stopped at the cursor: the remote may hold a
+            # long post-checkpoint tail this verification must not load
+            done = False
+            async with aclosing(
+                storage.iter_op_chunks([(actor, v)])
+            ) as chunks:
+                async for files in chunks:
+                    for _, version, blob in files:
+                        if version > last:
+                            done = True  # a tail the checkpoint never folded
+                            break
+                        try:
+                            ops = await open_sealed(blob)
+                        except Exception as e:
+                            report.add(
+                                "error", "ops",
+                                f"{actor.hex()}:{version}", f"{e}",
+                            )
+                            return report
+                        for o in ops:
+                            refold.apply(adapter.op_from_obj(o))
+                            report.ops_decoded += 1
+                        report.op_files += 1
+                        v = version + 1
+                    if done:
+                        break
+            if v <= last:
+                unverifiable.append((actor, v, last))
+        if unverifiable:
+            for actor, v, last in unverifiable:
+                report.add(
+                    "warn", "checkpoint", actor.hex(),
+                    f"op files v{v}..v{last} are gone from the remote "
+                    "and no snapshot covers them; refold incomplete — "
+                    "checkpoint unverifiable",
+                )
+            return report
+        ck_bytes = codec.pack(adapter.state_to_obj(ck_state))
+        rf_bytes = codec.pack(adapter.state_to_obj(refold))
+        if ck_bytes != rf_bytes:
+            report.add(
+                "error", "checkpoint", "local",
+                f"checkpointed state ({len(ck_bytes)}B canonical) diverges "
+                f"from the remote refold ({len(rf_bytes)}B canonical)",
+            )
+    return report
+
+
+ADAPTERS = {
+    "orset": "orset_adapter",
+    "gcounter": "gcounter_adapter",
+    "pncounter": "pncounter_adapter",
+    "lwwmap": "lwwmap_adapter",
+    "mvreg": "mvreg_adapter",
+    "gset": "gset_adapter",
+    "lwwreg": "lwwreg_adapter",
+    "merklereg": "merklereg_adapter",
+    "list": "list_adapter",
+    "map": "map_adapter",
+}
+
+
 async def _list_op_versions(storage, actor) -> list[int] | None:
     """Sorted op-file versions for one actor WITHOUT reading file bytes,
     or None when the backend cannot enumerate them (no fs directory and
@@ -258,6 +414,13 @@ def main(argv=None) -> int:
     ap.add_argument("--obs", action="store_true",
                     help="print the fsck phase table (and append a "
                     "snapshot to CRDT_OBS_SINK if set)")
+    ap.add_argument("--verify-checkpoint", metavar="LOCAL_DIR",
+                    help="additionally verify LOCAL_DIR's fold checkpoint: "
+                    "refold the remote up to the checkpoint cursor and "
+                    "byte-compare (error row + exit 1 on divergence)")
+    ap.add_argument("--adapter", default="orset", choices=sorted(ADAPTERS),
+                    help="CRDT adapter for checkpoint/op decoding "
+                    "(--verify-checkpoint only; default orset)")
     args = ap.parse_args(argv)
 
     from ..backends import (
@@ -267,17 +430,28 @@ def main(argv=None) -> int:
         XChaChaCryptor,
     )
 
+    def make_kc():
+        return (
+            PassphraseKeyCryptor(args.passphrase)
+            if args.passphrase
+            else PlainKeyCryptor()
+        )
+
     async def go():
         with tempfile.TemporaryDirectory() as scratch:
             storage = FsStorage(scratch, args.remote)
-            kc = (
-                PassphraseKeyCryptor(args.passphrase)
-                if args.passphrase
-                else PlainKeyCryptor()
-            )
             report = await fsck_remote(
-                storage, XChaChaCryptor(), kc, deep=not args.shallow
+                storage, XChaChaCryptor(), make_kc(), deep=not args.shallow
             )
+            if args.verify_checkpoint:
+                from ..core import adapters as _adapters
+
+                local = FsStorage(args.verify_checkpoint, args.remote)
+                vc = await verify_checkpoint(
+                    local, storage, XChaChaCryptor(), make_kc(),
+                    adapter=getattr(_adapters, ADAPTERS[args.adapter])(),
+                )
+                report.issues.extend(vc.issues)
         for issue in report.issues:
             print(issue)
         print(report.summary())

@@ -1,0 +1,493 @@
+"""Warm-open fold checkpoints (ISSUE 4): safety, fidelity, fallbacks.
+
+The local checkpoint is a CACHE, never a source of truth — every test
+here pins one side of that contract: a verified checkpoint restores a
+state byte-identical to a cold refold (across model adapters and both
+storage backends), and ANY doubt (torn file, rotated key, wiped remote,
+wrong adapter) falls back to the cold path with the reason traced.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from crdt_enc_tpu.backends import (
+    FsStorage,
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import (
+    Core,
+    OpenOptions,
+    gcounter_adapter,
+    gset_adapter,
+    lwwmap_adapter,
+    map_adapter,
+    mvreg_adapter,
+    orset_adapter,
+    pncounter_adapter,
+)
+from crdt_enc_tpu.models import canonical_bytes
+from crdt_enc_tpu.utils import codec, trace
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_opts(storage, adapter, create=True, **kw):
+    return OpenOptions(
+        storage=storage,
+        cryptor=IdentityCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=adapter,
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=create,
+        **kw,
+    )
+
+
+@pytest.fixture(params=["memory", "fs"])
+def storage_factory(request, tmp_path):
+    """() -> Storage factories sharing one remote; same-name reuse gives
+    the same local dir (the warm-open identity)."""
+    if request.param == "memory":
+        remote = MemoryRemote()
+        instances: dict = {}
+
+        def make(name="a"):
+            return instances.setdefault(name, MemoryStorage(remote))
+
+        return make
+    remote_dir = tmp_path / "remote"
+
+    def make(name="a"):
+        return FsStorage(str(tmp_path / f"local-{name}"), str(remote_dir))
+
+    return make
+
+
+# ---- checkpoint codec ------------------------------------------------------
+
+
+def test_columnar_checkpoint_roundtrip_randomized():
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.models.orset import AddOp, RmOp
+    from crdt_enc_tpu.models.vclock import Dot, VClock
+    from crdt_enc_tpu.ops.columnar import (
+        orset_pack_checkpoint,
+        orset_unpack_checkpoint,
+    )
+
+    rng = random.Random(7)
+    actors = [bytes([i]) * 16 for i in range(12)]
+    s = ORSet()
+    for _ in range(1500):
+        a = rng.choice(actors)
+        m = rng.choice([b"b", 3, "s", (1, "t"), rng.randrange(40)])
+        s.apply(AddOp(m, s.clock.inc(a)))
+        if rng.random() < 0.25 and s.entries:
+            m2 = rng.choice(list(s.entries))
+            s.apply(RmOp(m2, VClock(dict(s.entries[m2]))))
+    s.apply(RmOp(b"ahead", VClock({b"z" * 16: 9})))  # deferred horizon
+    wire = codec.unpack(codec.pack(orset_pack_checkpoint(s)))
+    r = orset_unpack_checkpoint(wire)
+    assert codec.pack(r.to_obj()) == codec.pack(s.to_obj())
+
+
+def test_columnar_checkpoint_empty_and_overflow():
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.ops.columnar import (
+        orset_pack_checkpoint,
+        orset_unpack_checkpoint,
+    )
+
+    empty = orset_unpack_checkpoint(
+        codec.unpack(codec.pack(orset_pack_checkpoint(ORSet())))
+    )
+    assert codec.pack(empty.to_obj()) == codec.pack(ORSet().to_obj())
+    big = ORSet()
+    big.clock.counters[b"a" * 16] = 2**70  # outside int64
+    assert orset_pack_checkpoint(big) is None  # generic fmt takes over
+
+
+# ---- warm open == cold open, across adapters (differential) ----------------
+
+
+def _ops_orset(core, i):
+    return core.with_state(
+        lambda s: s.add_ctx(core.actor_id, b"m%d" % (i % 7))
+    )
+
+
+def _ops_orset_rm(core, i):
+    if i % 5 == 4:
+        return core.with_state(lambda s: s.rm_ctx(b"m%d" % (i % 7)))
+    return _ops_orset(core, i)
+
+
+def _ops_gcounter(core, i):
+    return core.with_state(lambda s: s.inc(core.actor_id, 1 + i % 3))
+
+
+def _ops_pncounter(core, i):
+    if i % 3 == 2:
+        return core.with_state(lambda s: s.dec(core.actor_id))
+    return core.with_state(lambda s: s.inc(core.actor_id))
+
+
+def _ops_mvreg(core, i):
+    return core.with_state(lambda s: s.write_ctx(core.actor_id, [b"v", i]))
+
+
+def _ops_gset(core, i):
+    return [b"g%d" % (i % 9)]  # the op IS the member
+
+
+def _ops_lwwmap(core, i):
+    from crdt_enc_tpu.models import LWWOp
+
+    return LWWOp(b"k%d" % (i % 4), 1000 + i, core.actor_id, b"v%d" % i)
+
+
+def _ops_map(core, i):
+    from crdt_enc_tpu.models.orset import AddOp
+
+    def build(s):
+        return s.update_ctx(
+            core.actor_id, "k%d" % (i % 3), lambda c, d: AddOp(i % 5, d)
+        )
+
+    return core.with_state(build)
+
+
+ADAPTER_CASES = [
+    ("orset", orset_adapter, _ops_orset_rm),
+    ("gcounter", gcounter_adapter, _ops_gcounter),
+    ("pncounter", pncounter_adapter, _ops_pncounter),
+    ("mvreg", mvreg_adapter, _ops_mvreg),
+    ("gset", gset_adapter, _ops_gset),
+    ("lwwmap", lwwmap_adapter, _ops_lwwmap),
+    ("map+orset", lambda: map_adapter(b"orset"), _ops_map),
+]
+
+
+@pytest.mark.parametrize(
+    "name,mk_adapter,build", ADAPTER_CASES, ids=[c[0] for c in ADAPTER_CASES]
+)
+def test_warm_open_byte_identical_to_cold(storage_factory, name, mk_adapter, build):
+    """The differential: compact → warm reopen vs a cold replica, plus a
+    post-checkpoint tail only the ingest path can deliver — resulting
+    states must be byte-identical for every adapter."""
+
+    async def go():
+        s_a = storage_factory("a")
+        c1 = await Core.open(make_opts(s_a, mk_adapter()))
+        for i in range(24):
+            op = build(c1, i)
+            await c1.apply_ops(op if isinstance(op, list) else [op])
+        await c1.compact()
+        # a tail past the checkpoint, from another replica
+        w = await Core.open(make_opts(storage_factory("w"), mk_adapter()))
+        for i in range(24, 30):
+            op = build(w, i)
+            await w.apply_ops(op if isinstance(op, list) else [op])
+        # warm reopen of replica A's local dir
+        warm = await Core.open(
+            make_opts(storage_factory("a"), mk_adapter(), create=False)
+        )
+        assert warm.opened_from_checkpoint, warm.checkpoint_fallback_reason
+        await warm.read_remote()
+        # cold replica refolds everything
+        cold = await Core.open(make_opts(storage_factory("c"), mk_adapter()))
+        await cold.read_remote()
+        assert warm.with_state(canonical_bytes) == cold.with_state(
+            canonical_bytes
+        )
+
+    run(go())
+
+
+def test_warm_open_skips_refold(storage_factory):
+    """Warm open must not re-read the compacted history: the tail ingest
+    touches only files past the cursor."""
+
+    async def go():
+        s_a = storage_factory("a")
+        c1 = await Core.open(make_opts(s_a, orset_adapter()))
+        for i in range(40):
+            await c1.apply_ops([_ops_orset(c1, i)])
+        await c1.compact()
+        w = await Core.open(make_opts(storage_factory("w"), orset_adapter()))
+        await w.apply_ops([_ops_orset(w, 99)])
+        trace.reset()
+        warm = await Core.open(
+            make_opts(storage_factory("a"), orset_adapter(), create=False)
+        )
+        assert warm.opened_from_checkpoint
+        await warm.read_remote()
+        counters = trace.snapshot()["counters"]
+        trace.reset()
+        folded = counters.get("ops_folded", 0) + counters.get(
+            "op_files_bulk_folded", 0
+        )
+        assert folded <= 1, f"warm open refolded history: {counters}"
+        # and the warm state still contains the full history
+        assert warm.with_state(lambda s: s.contains(b"m0"))
+
+    run(go())
+
+
+def test_checkpoint_on_read_consumer_replica(storage_factory):
+    """A pure consumer (never compacts) with checkpoint_on_read reseals
+    after each ingest and warm-opens from it."""
+
+    async def go():
+        w = await Core.open(make_opts(storage_factory("w"), orset_adapter()))
+        for i in range(20):
+            await w.apply_ops([_ops_orset(w, i)])
+        s_r = storage_factory("r")
+        reader = await Core.open(
+            make_opts(s_r, orset_adapter(), checkpoint_on_read=True)
+        )
+        await reader.read_remote()
+        reopened = await Core.open(
+            make_opts(storage_factory("r"), orset_adapter(), create=False)
+        )
+        assert reopened.opened_from_checkpoint
+        assert reopened.with_state(canonical_bytes) == reader.with_state(
+            canonical_bytes
+        )
+
+    run(go())
+
+
+# ---- fallbacks -------------------------------------------------------------
+
+
+def _truncate_checkpoint(storage) -> None:
+    if isinstance(storage, MemoryStorage):
+        assert storage._local_checkpoint
+        storage._local_checkpoint = storage._local_checkpoint[:-7]
+    else:
+        import os
+
+        path = storage._local_checkpoint_path()
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[:-7])
+
+
+def test_torn_checkpoint_falls_back_cold(storage_factory):
+    async def go():
+        s_a = storage_factory("a")
+        c1 = await Core.open(make_opts(s_a, orset_adapter()))
+        for i in range(25):
+            await c1.apply_ops([_ops_orset_rm(c1, i)])
+        await c1.compact()
+        cold_bytes = c1.with_state(canonical_bytes)
+        _truncate_checkpoint(storage_factory("a"))
+        trace.reset()
+        warm = await Core.open(
+            make_opts(storage_factory("a"), orset_adapter(), create=False)
+        )
+        assert not warm.opened_from_checkpoint
+        assert warm.checkpoint_fallback_reason == "unreadable"
+        assert trace.snapshot()["counters"].get("checkpoint_fallbacks") == 1
+        trace.reset()
+        await warm.read_remote()
+        assert warm.with_state(canonical_bytes) == cold_bytes
+
+    run(go())
+
+
+def test_key_rotation_invalidates_checkpoint(storage_factory):
+    async def go():
+        s_a = storage_factory("a")
+        c1 = await Core.open(make_opts(s_a, orset_adapter()))
+        for i in range(10):
+            await c1.apply_ops([_ops_orset(c1, i)])
+        await c1.compact()
+        await c1.rotate_key()  # checkpoint now belongs to an old generation
+        warm = await Core.open(
+            make_opts(storage_factory("a"), orset_adapter(), create=False)
+        )
+        assert not warm.opened_from_checkpoint
+        assert warm.checkpoint_fallback_reason == "key_rotation"
+        await warm.read_remote()
+        assert warm.with_state(canonical_bytes) == c1.with_state(
+            canonical_bytes
+        )
+
+    run(go())
+
+
+def test_adapter_mismatch_falls_back(storage_factory):
+    async def go():
+        s_a = storage_factory("a")
+        c1 = await Core.open(make_opts(s_a, gcounter_adapter()))
+        await c1.apply_ops([c1.with_state(lambda s: s.inc(c1.actor_id, 3))])
+        await c1.compact()
+        warm = await Core.open(
+            make_opts(storage_factory("a"), orset_adapter(), create=False)
+        )
+        assert not warm.opened_from_checkpoint
+        assert warm.checkpoint_fallback_reason == "adapter"
+
+    run(go())
+
+
+def test_wiped_remote_rejects_checkpoint(tmp_path):
+    """A checkpoint must never install over a remote it did not come
+    from: wipe the remote, re-bootstrap, reopen the old local dir."""
+    import shutil
+
+    remote = tmp_path / "remote"
+
+    async def go():
+        c1 = await Core.open(
+            make_opts(
+                FsStorage(str(tmp_path / "localA"), str(remote)),
+                orset_adapter(),
+            )
+        )
+        for i in range(12):
+            await c1.apply_ops([_ops_orset(c1, i)])
+        await c1.compact()
+        shutil.rmtree(remote)
+        # someone re-creates a fresh remote under the same path
+        boot = await Core.open(
+            make_opts(
+                FsStorage(str(tmp_path / "localB"), str(remote)),
+                orset_adapter(),
+            )
+        )
+        await boot.apply_ops([_ops_orset(boot, 0)])
+        warm = await Core.open(
+            make_opts(
+                FsStorage(str(tmp_path / "localA"), str(remote)),
+                orset_adapter(),
+                create=False,
+            )
+        )
+        assert not warm.opened_from_checkpoint
+        # the fresh remote bootstrapped a new key generation (and new
+        # metadata) — either fingerprint check must trip
+        assert warm.checkpoint_fallback_reason in (
+            "key_rotation", "remote_meta", "unreadable",
+        )
+
+    run(go())
+
+
+def test_checkpoint_disabled_never_writes(storage_factory):
+    async def go():
+        s_a = storage_factory("a")
+        c1 = await Core.open(
+            make_opts(s_a, orset_adapter(), checkpoint=False)
+        )
+        for i in range(8):
+            await c1.apply_ops([_ops_orset(c1, i)])
+        await c1.compact()
+        assert not await c1.save_checkpoint()
+        assert await s_a.load_local_checkpoint() is None
+
+    run(go())
+
+
+# ---- fsck --verify-checkpoint ---------------------------------------------
+
+
+def test_fsck_verify_checkpoint_ok_and_divergent(storage_factory):
+    from crdt_enc_tpu.tools.fsck import verify_checkpoint
+
+    async def go():
+        s_a = storage_factory("a")
+        c1 = await Core.open(make_opts(s_a, orset_adapter()))
+        for i in range(25):
+            await c1.apply_ops([_ops_orset_rm(c1, i)])
+        # pre-compact: refold replays op files
+        await c1.save_checkpoint()
+        r = await verify_checkpoint(
+            s_a, storage_factory("x"), IdentityCryptor(), PlainKeyCryptor()
+        )
+        assert r.ok and r.op_files > 0, [str(i) for i in r.issues]
+        # post-compact: refold goes through the snapshot
+        await c1.compact()
+        r = await verify_checkpoint(
+            s_a, storage_factory("x"), IdentityCryptor(), PlainKeyCryptor()
+        )
+        assert r.ok and r.state_files == 1, [str(i) for i in r.issues]
+        # forge a diverging checkpoint (sealed correctly, wrong state)
+        from crdt_enc_tpu.models import ORSet
+        from crdt_enc_tpu.models.orset import AddOp
+        from crdt_enc_tpu.models.vclock import Dot
+
+        real = c1._data.state
+        bogus = ORSet()
+        bogus.apply(AddOp(b"bogus", Dot(c1.actor_id, 1)))
+        c1._data.state = bogus
+        await c1.save_checkpoint()
+        c1._data.state = real
+        r = await verify_checkpoint(
+            s_a, storage_factory("x"), IdentityCryptor(), PlainKeyCryptor()
+        )
+        assert not r.ok
+        assert any(
+            i.family == "checkpoint" and "diverges" in i.problem
+            for i in r.issues
+        )
+
+    run(go())
+
+
+def test_fsck_cli_verify_checkpoint_flag(tmp_path):
+    """End-to-end CLI: a real XChaCha-sealed remote, --verify-checkpoint
+    passes on an honest local dir and exits 1 on a forged one."""
+    pytest.importorskip("crdt_enc_tpu.native")
+    from crdt_enc_tpu.backends import XChaChaCryptor
+    from crdt_enc_tpu.tools import fsck as fsck_cli
+
+    try:
+        from crdt_enc_tpu import native
+
+        native.load()
+    except Exception:
+        pytest.skip("native crypto unavailable")
+
+    remote = str(tmp_path / "remote")
+    local = str(tmp_path / "localA")
+
+    async def build():
+        c1 = await Core.open(
+            OpenOptions(
+                storage=FsStorage(local, remote),
+                cryptor=XChaChaCryptor(),
+                key_cryptor=PlainKeyCryptor(),
+                adapter=orset_adapter(),
+                supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+                current_data_version=DEFAULT_DATA_VERSION_1,
+                create=True,
+            )
+        )
+        for i in range(20):
+            await c1.apply_ops([_ops_orset(c1, i)])
+        await c1.compact()
+        return c1
+
+    run(build())
+    assert fsck_cli.main([remote, "--verify-checkpoint", local]) == 0
+    # a torn checkpoint is an error row for fsck (the core would fall
+    # back silently; fsck's job is to say so loudly)
+    import os
+
+    path = os.path.join(local, "checkpoint.msgpack")
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[:-5])
+    assert fsck_cli.main([remote, "--verify-checkpoint", local]) == 1

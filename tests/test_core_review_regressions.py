@@ -44,7 +44,7 @@ class CheckedCryptor(IdentityCryptor):
         return payload
 
 
-def make_opts(storage, cryptor=None, create=True):
+def make_opts(storage, cryptor=None, create=True, **kw):
     return OpenOptions(
         storage=storage,
         cryptor=cryptor or CheckedCryptor(),
@@ -53,6 +53,7 @@ def make_opts(storage, cryptor=None, create=True):
         supported_data_versions=(DEFAULT_DATA_VERSION_1,),
         current_data_version=DEFAULT_DATA_VERSION_1,
         create=create,
+        **kw,
     )
 
 
@@ -100,7 +101,10 @@ def test_producer_cursor_survives_restart(tmp_path):
     read_remote: the new op file must land past the compacted range so
     consumers whose scan cursor is already beyond v1 still find it.
     (Without the durable cursor it lands at v1 and is invisible to them
-    forever — the silent-loss scenario.)"""
+    forever — the silent-loss scenario.)  Checkpointing is disabled for
+    the restart: this test pins the durable-CURSOR guarantee on a cold
+    open with empty state (the warm-open twin below pins the
+    checkpointed restart, where increments continue)."""
 
     async def go():
         local, remote = str(tmp_path / "l1"), str(tmp_path / "r")
@@ -113,8 +117,10 @@ def test_producer_cursor_survives_restart(tmp_path):
         c2 = await Core.open(make_opts(FsStorage(str(tmp_path / "l2"), remote)))
         await c2.read_remote()
         assert c2.with_state(lambda s: s.read()) == 5
-        # restart the producer; write immediately (no read_remote)
-        c1b = await Core.open(make_opts(FsStorage(local, remote), create=False))
+        # restart the producer COLD; write immediately (no read_remote)
+        c1b = await Core.open(
+            make_opts(FsStorage(local, remote), create=False, checkpoint=False)
+        )
         assert c1b.actor_id == actor
         await c1b.update(lambda s: s.inc(actor, 10))
         # the op file must be at v3 — past the compacted v1..v2 range
@@ -126,6 +132,30 @@ def test_producer_cursor_survives_restart(tmp_path):
         # increments read_remote first, the documented resume protocol)
         await c2.read_remote()
         assert c2.with_state(lambda s: s.read()) == 10
+
+    asyncio.run(go())
+
+
+def test_producer_restart_warm_checkpoint_continues_increments(tmp_path):
+    """The checkpointed restart (default): the warm open restores the
+    compacted state, so an immediate write continues from it — the
+    resume protocol's result without an explicit read_remote."""
+
+    async def go():
+        local, remote = str(tmp_path / "l1"), str(tmp_path / "r")
+        c1 = await Core.open(make_opts(FsStorage(local, remote)))
+        actor = c1.actor_id
+        await c1.update(lambda s: s.inc(actor, 3))
+        await c1.update(lambda s: s.inc(actor, 2))
+        await c1.compact()
+        c1b = await Core.open(make_opts(FsStorage(local, remote), create=False))
+        assert c1b.opened_from_checkpoint
+        await c1b.update(lambda s: s.inc(actor, 10))
+        ops_dir = tmp_path / "r" / "ops" / actor.hex()
+        assert sorted(p.name for p in ops_dir.iterdir()) == ["3"]
+        c2 = await Core.open(make_opts(FsStorage(str(tmp_path / "l2"), remote)))
+        await c2.read_remote()
+        assert c2.with_state(lambda s: s.read()) == 15
 
     asyncio.run(go())
 
