@@ -1,0 +1,35 @@
+"""crdt_enc_tpu.analysis — the project-invariant static-analysis engine.
+
+One AST parse pass over the package, a plugin rule registry encoding the
+invariants this codebase has been burned by (FFI contracts, jit
+recompile bounds, silent native fallbacks, thread discipline, span
+registry, H2D accounting, key-material taint), inline pragmas plus a
+committed baseline for deliberate exceptions, and a CLI
+(``python -m crdt_enc_tpu.tools.analyze``).  See docs/static_analysis.md.
+"""
+
+from .baseline import Baseline
+from .engine import (
+    SEV_ERROR,
+    SEV_WARNING,
+    Finding,
+    ModuleInfo,
+    Project,
+    all_rules,
+    run,
+    rule,
+    unsuppressed_errors,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "ModuleInfo",
+    "Project",
+    "SEV_ERROR",
+    "SEV_WARNING",
+    "all_rules",
+    "rule",
+    "run",
+    "unsuppressed_errors",
+]

@@ -1,0 +1,186 @@
+"""CLI for the static-analysis engine.
+
+``python -m crdt_enc_tpu.tools.analyze [--json] [--diff-baseline]
+[--rule RULE ...] [--list-rules] [--root DIR] [paths...]``
+
+Exit codes: 0 = no unsuppressed error-severity findings (and, under
+``--diff-baseline``, no stale baseline entries either); 1 = violations;
+2 = usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from .baseline import Baseline
+from .engine import (
+    SEV_ERROR,
+    Project,
+    all_rules,
+    run,
+    unsuppressed_errors,
+)
+
+BASELINE_REL = "tools/analysis_baseline.toml"
+JSON_SCHEMA_VERSION = 1
+
+
+def default_root() -> pathlib.Path:
+    # crdt_enc_tpu/analysis/cli.py -> repo root
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m crdt_enc_tpu.tools.analyze",
+        description="Project-invariant static analysis (docs/static_analysis.md)",
+    )
+    p.add_argument("paths", nargs="*", help="restrict to these files")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--diff-baseline", action="store_true",
+        help="also fail on stale baseline entries (the committed baseline "
+        "must exactly cover the deliberate exceptions)",
+    )
+    p.add_argument(
+        "--rule", action="append", dest="rules", metavar="RULE",
+        help="run only this rule (repeatable)",
+    )
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the committed baseline (show everything)",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: auto)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = pathlib.Path(args.root).resolve() if args.root else default_root()
+
+    if args.list_rules:
+        for name, (_fn, sev, doc) in sorted(all_rules().items()):
+            head = doc.splitlines()[0] if doc else ""
+            print(f"{name}  [{sev}]  {head}")
+        return 0
+
+    if not (root / "crdt_enc_tpu").is_dir() or not (root / "docs").is_dir():
+        # an installed (site-packages) cli.py cannot infer the checkout
+        print(
+            f"{root} is not a repo checkout (no crdt_enc_tpu/ + docs/); "
+            "run from the repository or pass --root",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        rules = args.rules
+        if rules:
+            unknown = set(rules) - set(all_rules())
+            if unknown:
+                print(f"unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+                return 2
+        baseline = (
+            None if args.no_baseline else Baseline.load(root / BASELINE_REL)
+        )
+    except ValueError as e:
+        print(f"baseline error: {e}", file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    try:
+        raw = []
+        for arg in args.paths:
+            p = pathlib.Path(arg).resolve()
+            if p.is_dir():
+                # a directory argument means "every in-scope file under
+                # it" — without the expansion `crdt-analyze foo/` would
+                # analyze zero files yet exit 0
+                found = sorted(
+                    f for f in p.rglob("*.py")
+                    if Project.in_scan_scope(root, f)
+                )
+                if not found:
+                    print(
+                        f"note: {p.relative_to(root).as_posix()} "
+                        "contains no in-scope files, skipped",
+                        file=sys.stderr,
+                    )
+                raw.extend(found)
+            else:
+                raw.append(p)
+        skipped = [p for p in raw if not Project.in_scan_scope(root, p)]
+        for p in skipped:
+            # tests/, tools/, docs/ are exempt by contract (SCAN_GLOBS):
+            # a hook feeding changed files must not get spurious errors
+            print(
+                f"note: {p.relative_to(root).as_posix()} is outside the "
+                "analysis scope, skipped",
+                file=sys.stderr,
+            )
+        paths = [p for p in raw if p not in skipped] if args.paths else None
+        project = Project(root, paths)
+    except (ValueError, OSError) as e:
+        # an explicit path outside the root, or unreadable
+        print(f"path error: {e}", file=sys.stderr)
+        return 2
+    findings = run(project, rules, baseline)
+    elapsed = time.monotonic() - t0
+
+    stale = baseline.stale_entries() if baseline is not None else []
+    if rules:  # a subset run can't judge other rules' entries
+        stale = [e for e in stale if e.rule in rules]
+    if project.partial:  # nor can a path-subset run judge any of them
+        stale = []
+    errors = unsuppressed_errors(findings)
+    visible = [f for f in findings if f.suppressed is None]
+    suppressed = [f for f in findings if f.suppressed is not None]
+
+    if args.json:
+        out = {
+            "version": JSON_SCHEMA_VERSION,
+            "root": str(root),
+            "elapsed_s": round(elapsed, 3),
+            "rules": sorted(rules) if rules else sorted(all_rules()),
+            "findings": [f.to_json() for f in findings],
+            "stale_baseline": [
+                {"rule": e.rule, "path": e.path, "context": e.context,
+                 "reason": e.reason}
+                for e in stale
+            ],
+            "summary": {
+                "files": len(project.modules),
+                "errors": len(errors),
+                "warnings": len(
+                    [f for f in visible if f.severity != SEV_ERROR]
+                ),
+                "suppressed": len(suppressed),
+            },
+        }
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        for f in visible:
+            print(f.render())
+        for e in stale:
+            print(
+                f"STALE baseline entry {e.rule} {e.path}"
+                + (f" ({e.context})" if e.context else "")
+                + f" matched nothing — delete it (reason was: {e.reason})"
+            )
+        n_warn = len([f for f in visible if f.severity != SEV_ERROR])
+        print(
+            f"{len(project.modules)} files, {len(errors)} error(s), "
+            f"{n_warn} warning(s), {len(suppressed)} suppressed, "
+            f"{len(stale)} stale baseline entr(y/ies) in {elapsed:.2f}s"
+        )
+
+    if errors:
+        return 1
+    if args.diff_baseline and stale:
+        return 1
+    return 0

@@ -1,0 +1,6 @@
+"""Rule plugins.  Importing this package registers every rule with the
+engine registry (``crdt_enc_tpu.analysis.engine.rule``); adding a rule
+is: write a module here, decorate the entry point, import it below, and
+document it in docs/static_analysis.md."""
+
+from . import exc, ffi, jit, obs, sec, spans, threads  # noqa: F401

@@ -1,0 +1,120 @@
+"""EXC001 — no silent broad except around native/crypto fast paths.
+
+A ``except Exception: <fall back>`` around a ``native.*`` or xchacha
+fast path is how the project has repeatedly lost its native
+optimizations without noticing (ADVICE r5: a binding regression made
+``bytes_lens_join`` raise, the broad except ate it, and every bulk
+decrypt silently ran the slow Python path for a round).  The fix
+pattern is established (``_warn_no_native_lens``): fall back, but LOG
+once.  This rule enforces it: a broad handler (bare ``except``,
+``Exception``, ``BaseException``) whose try body touches a native
+fast-path root must either re-raise or call something that visibly
+logs (``logger.warning/...``, a ``*warn*`` helper, ``warnings.warn``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import call_name, walk_in
+from ..engine import SEV_ERROR, Finding, Project, rule
+from .ffi import _LIB_NAMES
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_ATTRS = {"warning", "error", "exception", "info", "debug", "critical"}
+_WARN_NAME_RE = re.compile(r"warn", re.IGNORECASE)
+
+
+def _fast_path_roots(mod) -> set[str]:
+    """Module-level names that are native fast-path entry points: the
+    ``native`` package itself and the xchacha backend, however imported."""
+    roots = set()
+    for node in mod.walk(ast.Import, ast.ImportFrom):
+        if isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if alias.name in ("native", "xchacha") or module.endswith(
+                    ("native", "xchacha")
+                ):
+                    roots.add(name)
+        else:
+            for alias in node.names:
+                if alias.name.endswith(("native", "xchacha")):
+                    roots.add(alias.asname or alias.name.split(".")[0])
+    return roots
+
+
+def _touches_fast_path(body: list[ast.stmt], roots: set[str]) -> bool:
+    for stmt in body:
+        for node in walk_in(stmt):
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ):
+                if node.value.id in roots:
+                    return True
+            if isinstance(node, ast.Call):
+                cn = call_name(node) or ""
+                recv = cn.rsplit(".", 1)[0] if "." in cn else ""
+                if recv in roots or cn.split(".")[0] in roots:
+                    return True
+                # calls through a native handle are native calls (the
+                # receiver spellings are FFI001's, kept in one place)
+                if recv in _LIB_NAMES:
+                    return True
+    return False
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in ([t] if not isinstance(t, ast.Tuple) else t.elts):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in _BROAD for n in names)
+
+
+def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
+    for node in walk_in(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            cn = call_name(node) or ""
+            last = cn.rsplit(".", 1)[-1]
+            if last in _LOG_ATTRS:
+                return True
+            if _WARN_NAME_RE.search(last):
+                return True
+    return False
+
+
+@rule("EXC001", SEV_ERROR)
+def silent_native_fallback(project: Project):
+    """Broad except around a native/xchacha fast path must re-raise or
+    log the fallback (one-shot helpers count)."""
+    for mod in project.modules:
+        roots = _fast_path_roots(mod)
+        for node in mod.walk(ast.Try):
+            if not _touches_fast_path(node.body, roots):
+                continue
+            for handler in node.handlers:
+                if not _is_broad(handler):
+                    continue
+                if _handler_is_loud(handler):
+                    continue
+                yield Finding(
+                    rule="EXC001", severity=SEV_ERROR, path=mod.rel,
+                    line=handler.lineno, context=mod.context_of(handler),
+                    message=(
+                        "broad except swallows a native fast-path failure "
+                        "with no logged fallback — the optimization can "
+                        "silently disable (bytes_lens_join regression "
+                        "class); log once (e.g. a _warn_* helper) or "
+                        "re-raise"
+                    ),
+                )

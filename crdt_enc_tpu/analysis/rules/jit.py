@@ -1,0 +1,468 @@
+"""JIT001/JIT002 — traced-value control flow and bounded static args.
+
+JIT001: a Python ``if``/``while`` on a traced value inside a
+``@jax.jit`` body raises ``TracerBoolConversionError`` at best and, when
+it happens to trace (e.g. a weak-typed scalar), silently bakes one
+branch into the compiled program.  Shape/dtype/None tests are static and
+allowed (``x.shape``, ``x.ndim``, ``x.dtype``, ``x is None``,
+``len(x)``, ``isinstance(x, ...)``).
+
+JIT002: every value passed for a ``static_argnums``/``static_argnames``
+parameter keys a separate compilation.  The per-batch Pallas recompile
+bug (ADVICE r5: ``lww_limbs`` computed from raw column values) is this
+rule's reason to exist: a static arg must be *provably bounded* at the
+call site — a literal, a module/instance constant, a shape, or the
+result of an allowlisted quantizer (``_bucket``, ``fold_cap``,
+``lww_limbs`` & co., which round data-dependent values onto a finite
+lattice).  ``len(...)`` and other raw data-dependent expressions are
+exactly the unbounded case.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (
+    call_name,
+    dotted,
+    enclosing,
+    func_params,
+    functions,
+    walk_in,
+)
+from ..engine import SEV_ERROR, Finding, Project, rule
+
+#: call names (last dotted segment) that quantize their input onto a
+#: finite lattice — the sanctioned ways to bound a static argument
+QUANTIZERS = {
+    "_bucket", "_round_to", "fold_cap", "sharded_fold_cap", "lww_tile_cap",
+    "lww_limbs", "lww_limbs_from_maxima", "stream_sharding",
+}
+#: builtins that preserve boundedness of already-bounded operands
+_BOUNDED_WRAPPERS = {"min", "max", "int", "bool", "abs", "range"}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize"}
+
+
+def _jit_decorator_info(fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """(is_jitted, static_names) from the decorator list, resolving
+    ``@jax.jit``, ``@jit``, ``@jax.jit(...)`` and
+    ``@partial(jax.jit, static_arg...=...)`` forms."""
+    for dec in fn.decorator_list:
+        call_kw = []
+        target = dec
+        if isinstance(dec, ast.Call):
+            name = call_name(dec) or ""
+            if name.rsplit(".", 1)[-1] == "partial" and dec.args:
+                target = dec.args[0]
+            else:
+                target = dec.func  # direct form: @jax.jit(static_...=...)
+            call_kw = dec.keywords
+        name = dotted(target) or ""
+        if name not in ("jit", "jax.jit"):
+            continue
+        statics: set[str] = set()
+        params = func_params(fn)
+        for kw in call_kw:
+            if kw.arg == "static_argnames":
+                for s in walk_in(kw.value, ast.Constant):
+                    if isinstance(s.value, str):
+                        statics.add(s.value)
+            elif kw.arg == "static_argnums":
+                for s in walk_in(kw.value, ast.Constant):
+                    if isinstance(s.value, int) and s.value < len(params):
+                        statics.add(params[s.value])
+        return True, statics
+    return False, set()
+
+
+def _allowed_traced_use(mod, name_node: ast.Name, test: ast.AST) -> bool:
+    """Is this traced-param reference inside the test static-safe?"""
+    cur = name_node
+    parent = mod.parents.get(cur)
+    while parent is not None and cur is not test:
+        if isinstance(parent, ast.Attribute) and parent.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(parent, ast.Call):
+            cn = (call_name(parent) or "").rsplit(".", 1)[-1]
+            if cn in ("len", "isinstance", "getattr", "hasattr", "type"):
+                return True
+        if isinstance(parent, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+        ):
+            return True
+        cur, parent = parent, mod.parents.get(parent)
+    return False
+
+
+@rule("JIT001", SEV_ERROR)
+def jit_traced_branch(project: Project):
+    """No Python ``if``/``while`` on traced values inside ``@jit`` bodies."""
+    for mod in project.modules:
+        for fn in functions(mod):
+            jitted, statics = _jit_decorator_info(fn)
+            if not jitted:
+                continue
+            traced = set(func_params(fn)) - statics - {"self"}
+            for node in walk_in(fn, ast.If, ast.While):
+                test = node.test
+                for name in walk_in(test, ast.Name):
+                    if not isinstance(name.ctx, ast.Load):
+                        continue
+                    if name.id not in traced:
+                        continue
+                    if _allowed_traced_use(mod, name, test):
+                        continue
+                    yield Finding(
+                        rule="JIT001", severity=SEV_ERROR, path=mod.rel,
+                        line=node.lineno, context=mod.context_of(node),
+                        message=(
+                            f"Python branch on traced value `{name.id}` "
+                            f"inside @jit `{fn.name}` — use jnp.where/"
+                            "lax.cond, or declare the arg static"
+                        ),
+                    )
+                    break  # one finding per branch statement
+
+
+def _collect_jitted_callees(
+    project: Project,
+) -> dict[str, dict[int, tuple[set[str], list[str]]]]:
+    """name -> {function node id -> (static param names, positional
+    param order)} for every jit-decorated function in the tree — keyed
+    per definition so same-named jitted functions in different modules
+    keep their own signatures instead of merging."""
+    out: dict[str, dict[int, tuple[set[str], list[str]]]] = {}
+    for mod in project.modules:
+        for fn in functions(mod):
+            jitted, statics = _jit_decorator_info(fn)
+            if jitted and statics:
+                out.setdefault(fn.name, {})[id(fn)] = (
+                    statics, func_params(fn)
+                )
+    return out
+
+
+def _module_consts(mod) -> set[str]:
+    out = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.Constant, ast.BinOp)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class _Provenance:
+    """Bounded-value provenance within an enclosing function chain.
+
+    Closures see their enclosing functions' params and locals, so the
+    chain from the call site outward is the resolution scope.  Params
+    read pass-through are recorded in ``passthrough`` — they are only
+    sound to treat as bounded because :func:`jit_static_args_bounded`
+    registers the owning function as a forwarding target and checks
+    ITS call sites too (the fixpoint below)."""
+
+    def __init__(self, mod, fn_chain: list):
+        self.mod = mod
+        self.params: set[str] = set()
+        self.consts = _module_consts(mod)
+        self.assigns: dict[str, list[ast.AST]] = {}
+        self.passthrough: set[str] = set()
+        self._class = (
+            enclosing(mod, fn_chain[0], ast.ClassDef) if fn_chain else None
+        )
+        self._attr_visiting: set[str] = set()
+        self._name_visiting: set[str] = set()
+        for fn_node in fn_chain:
+            self.params.update(func_params(fn_node))
+            for a in walk_in(fn_node, ast.Assign):
+                for t in a.targets:
+                    self._record_target(t, a.value)
+            for loop in walk_in(fn_node, ast.For):
+                self._record_target(loop.target, loop.iter)
+
+    def _record_target(self, target: ast.AST, value: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.assigns.setdefault(target.id, []).append(value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = (
+                value.elts
+                if isinstance(value, (ast.Tuple, ast.List))
+                and len(value.elts) == len(target.elts)
+                else None
+            )
+            for i, t in enumerate(target.elts):
+                if isinstance(t, ast.Name):
+                    # element-wise when shapes line up, else the whole RHS
+                    # stands in (its boundedness bounds every element)
+                    self.assigns.setdefault(t.id, []).append(
+                        elts[i] if elts is not None else value
+                    )
+
+    def bounded(self, node: ast.AST, depth: int = 0) -> bool:
+        if depth > 8:
+            return False
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            if node.id in self.assigns:
+                if node.id in self._name_visiting:
+                    # self-referential rebind (`E = round_up(E)`): the
+                    # cycle itself adds no unboundedness — the non-cyclic
+                    # initializers decide
+                    return True
+                self._name_visiting.add(node.id)
+                try:
+                    return all(
+                        self.bounded(v, depth + 1)
+                        for v in self.assigns[node.id]
+                    )
+                finally:
+                    self._name_visiting.discard(node.id)
+            if node.id in self.params:
+                # pass-through: sound only because the rule registers the
+                # owning function as a forwarding target (fixpoint) and
+                # checks its call sites with the same provenance machinery
+                self.passthrough.add(node.id)
+                return True
+            return node.id in self.consts
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                # instance statics: bounded iff every in-class assignment
+                # to the attribute is itself bounded (unassigned attrs are
+                # external configuration — permissive)
+                return self._self_attr_bounded(node.attr, depth)
+            # module.CONST / deeper object attrs: fixed per process
+            return True
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Attribute) and base.attr == "shape":
+                return True
+            return self.bounded(base, depth + 1)
+        if isinstance(node, ast.Call):
+            full = call_name(node) or ""
+            cn = full.rsplit(".", 1)[-1]
+            if cn in QUANTIZERS:
+                return True
+            # process-constant configuration reads: one value per run
+            if full.endswith(("environ.get", "os.getenv")) or full == "getenv":
+                return True
+            # len() is shape-like: one compile per (bucketed) extent —
+            # the recompile bug class is VALUE-derived statics
+            # (`col.max()`), which stay unresolved here
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return True
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _BOUNDED_WRAPPERS
+            ):
+                return all(self.bounded(a, depth + 1) for a in node.args)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.bounded(node.left, depth + 1) and self.bounded(
+                node.right, depth + 1
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.bounded(node.operand, depth + 1)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(self.bounded(e, depth + 1) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.bounded(node.body, depth + 1) and self.bounded(
+                node.orelse, depth + 1
+            )
+        if isinstance(node, ast.Compare):
+            return True  # booleans are a 2-point lattice
+        if isinstance(node, ast.Starred):
+            return self.bounded(node.value, depth + 1)
+        return False
+
+    def _self_attr_bounded(self, attr: str, depth: int) -> bool:
+        cls = self._class
+        if cls is None or attr in self._attr_visiting:
+            return True
+        self._attr_visiting.add(attr)
+        try:
+            sites: list[tuple[ast.AST, ast.AST]] = []  # (method, value)
+            for m in walk_in(cls, ast.FunctionDef, ast.AsyncFunctionDef):
+                for a in walk_in(m, ast.Assign):
+                    for t in a.targets:
+                        if _is_self_attr(t, attr):
+                            sites.append((m, a.value))
+                for a in walk_in(m, ast.AnnAssign, ast.AugAssign):
+                    if a.value is not None and _is_self_attr(a.target, attr):
+                        sites.append((m, a.value))
+            if not sites:
+                return True
+            for m, value in sites:
+                sub = _Provenance(self.mod, [m])
+                sub._attr_visiting = self._attr_visiting
+                if not sub.bounded(value, depth + 1):
+                    return False
+            return True
+        finally:
+            self._attr_visiting.discard(attr)
+
+
+def _is_self_attr(target: ast.AST, attr: str) -> bool:
+    return (
+        isinstance(target, ast.Attribute)
+        and target.attr == attr
+        and isinstance(target.value, ast.Name)
+        and target.value.id in ("self", "cls")
+    )
+
+
+def _static_bound_args(call: ast.Call, statics: set[str], param_order: list):
+    """(param name, value node) for every arg bound to a static param."""
+    out: list[tuple[str, ast.AST]] = []
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            # *-unpacking: every later position binds an unknowable
+            # parameter — mapping by index past this point would check
+            # the wrong name (flag a bounded call, or admit the real
+            # static).  Keyword-bound statics below are still checked.
+            break
+        if i < len(param_order) and param_order[i] in statics:
+            out.append((param_order[i], a))
+    for kw in call.keywords:
+        if kw.arg in statics:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+@rule("JIT002", SEV_ERROR)
+def jit_static_args_bounded(project: Project):
+    """Static args at jitted-call sites must be provably bounded
+    (literal, constant, shape, or allowlisted quantizer).
+
+    Parameter pass-through is resolved by a forwarding fixpoint: when a
+    non-jitted wrapper's param flows into a static arg, the wrapper
+    becomes a checked target itself, so ``helper(int(col.max()))`` is
+    flagged at the OUTER call site instead of escaping through one
+    level of indirection."""
+    jitted = _collect_jitted_callees(project)
+    # name -> {owner node id -> (forwarded params, positional order)};
+    # keyed per OWNER so same-named wrappers in different modules keep
+    # their own param orders, and kept separate from ``jitted`` so a
+    # name collision with a real jitted function can't widen that
+    # function's static set
+    forward: dict[str, dict[int, tuple[set[str], list[str]]]] = {}
+    top_level: dict[int, dict[str, ast.AST]] = {}
+
+    def local_def(mod, full: str, cn: str):
+        """The module's own top-level function a bare call resolves to."""
+        if "." in full:
+            return None  # qualified: K.fold / module.fold
+        defs = top_level.get(id(mod))
+        if defs is None:
+            defs = top_level[id(mod)] = {
+                n.name: n
+                for n in mod.tree.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+        return defs.get(cn)
+    # the provenance index depends only on (module, innermost function),
+    # not on the fixpoint state — build each once, not per (call, arg)
+    chains: dict[int, list] = {}
+    provs: dict[tuple[int, int | None], _Provenance] = {}
+
+    def provenance(mod, fn_chain) -> _Provenance:
+        key = (id(mod), id(fn_chain[0]) if fn_chain else None)
+        prov = provs.get(key)
+        if prov is None:
+            prov = provs[key] = _Provenance(mod, fn_chain)
+        prov.passthrough = set()  # per-evaluation output channel
+        return prov
+
+    def resolve(mod, full: str, cn: str):
+        local = local_def(mod, full, cn)
+        if local is not None:
+            if _jit_decorator_info(local)[0]:
+                # the module's own jitted def: check against ITS
+                # signature only (None when it declares no statics)
+                return jitted.get(cn, {}).get(id(local))
+            # the call resolves to this module's own plain function:
+            # only check it against THAT function's forwarding entry
+            return forward.get(cn, {}).get(id(local))
+        jentries = jitted.get(cn, {})
+        if len(jentries) == 1:
+            return next(iter(jentries.values()))
+        if jentries:
+            # 2+ same-named jitted defs and no local one to pick by:
+            # guessing a signature would mis-map args — skip
+            return None
+        entries = forward.get(cn, {})
+        if len(entries) == 1:
+            return next(iter(entries.values()))
+        return None
+
+    def call_sites():
+        for mod in project.modules:
+            for call in mod.walk(ast.Call):
+                full = call_name(call) or ""
+                cn = full.rsplit(".", 1)[-1]
+                info = resolve(mod, full, cn)
+                if info is None:
+                    continue
+                fn_chain = chains.get(id(call))
+                if fn_chain is None:
+                    fn_chain = []
+                    cur = call
+                    while True:
+                        fn_node = enclosing(
+                            mod, cur, ast.FunctionDef, ast.AsyncFunctionDef
+                        )
+                        if fn_node is None:
+                            break
+                        fn_chain.append(fn_node)
+                        cur = fn_node
+                    chains[id(call)] = fn_chain
+                if any(_jit_decorator_info(fn)[0] for fn in fn_chain):
+                    # calls INSIDE another jit body are all traced-time
+                    continue
+                yield mod, call, cn, info, fn_chain
+
+    changed = True
+    while changed:
+        changed = False
+        for mod, call, cn, info, fn_chain in call_sites():
+            for pname, value in _static_bound_args(call, *info):
+                prov = provenance(mod, fn_chain)
+                if not prov.bounded(value):
+                    continue  # reported in the final pass
+                for used in prov.passthrough:
+                    owner = next(
+                        (f for f in fn_chain if used in func_params(f)), None
+                    )
+                    if owner is None:
+                        continue
+                    statics, order = forward.setdefault(
+                        owner.name, {}
+                    ).setdefault(id(owner), (set(), func_params(owner)))
+                    if used not in statics:
+                        statics.add(used)
+                        changed = True
+
+    for mod, call, cn, info, fn_chain in call_sites():
+        for pname, value in _static_bound_args(call, *info):
+            prov = provenance(mod, fn_chain)
+            if prov.bounded(value):
+                continue
+            role = (
+                f"static arg `{pname}` of jitted `{cn}`"
+                if cn in jitted
+                else f"arg `{pname}` of `{cn}` (flows into a jitted static)"
+            )
+            yield Finding(
+                rule="JIT002", severity=SEV_ERROR, path=mod.rel,
+                line=value.lineno, context=mod.context_of(call),
+                message=(
+                    f"{role} is not provably bounded — every distinct "
+                    "value compiles a new program; quantize via "
+                    "_bucket/fold_cap/lww_limbs or pass a constant"
+                ),
+            )

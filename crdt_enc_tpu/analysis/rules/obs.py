@@ -1,0 +1,106 @@
+"""OBS001 — every explicit H2D transfer flows through h2d_bytes accounting.
+
+The observability subsystem's transfer story (docs/observability.md,
+``h2d_bytes``) is only as trustworthy as its coverage: one
+``jax.device_put`` that bypasses the counter and the warm-open /
+plane-reuse proofs (PR 4) under-report transfers.  This rule pins the
+invariant: every explicit placement call in library code —
+``jax.device_put(...)``,
+``jax.make_array_from_process_local_data(...)`` (the multi-host
+spelling of the same transfer), or ``jnp.asarray(...)`` outside a jit
+body (on host data it IS an upload; inside jit it is a traced no-op)
+— must sit in a function that also issues
+``trace.add("h2d_bytes", ...)`` (or ``record.add``) — accounting at
+issue, the convention the streaming pipeline established.  Sites
+whose bytes are counted by a downstream aggregator (e.g. a ``put=``
+closure handed to ``fold_chunks_overlapped``, which accounts every
+chunk it issues) are point exceptions: pragma them with the
+accounting site named in the comment.
+
+Scope: ``crdt_enc_tpu/`` only — benchmarks measure, they don't serve.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, const_str, enclosing, walk_in
+from ..engine import SEV_ERROR, Finding, Project, rule
+from .jit import _jit_decorator_info
+
+#: full dotted spellings of the host→device array coercion; bare-name
+#: matching would also catch np.asarray, which never leaves the host
+_ASARRAY = {"jnp.asarray", "jax.numpy.asarray"}
+
+
+def _accounts_h2d(scope: ast.AST, *, module_level: bool = False) -> bool:
+    """Does ``scope`` issue ``*.add("h2d_bytes", ...)``?  For a module
+    scope only module-level statements count — accounting inside some
+    unrelated function must not excuse a module-level transfer."""
+    if module_level:
+        stack = list(getattr(scope, "body", []))
+        calls = []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+    else:
+        calls = walk_in(scope, ast.Call)
+    for call in calls:
+        cn = call_name(call) or ""
+        if cn.rsplit(".", 1)[-1] == "add" and call.args:
+            if const_str(call.args[0]) == "h2d_bytes":
+                return True
+    return False
+
+
+@rule("OBS001", SEV_ERROR)
+def device_put_accounted(project: Project):
+    """jax.device_put in library code must be h2d_bytes-accounted in the
+    same function."""
+    for mod in project.modules:
+        if not mod.rel.startswith("crdt_enc_tpu/"):
+            continue
+        checked: dict[ast.AST, bool] = {}
+        for call in mod.walk(ast.Call):
+            full = call_name(call) or ""
+            cn = full.rsplit(".", 1)[-1]
+            is_asarray = full in _ASARRAY
+            if not is_asarray and cn not in (
+                "device_put", "make_array_from_process_local_data"
+            ):
+                continue
+            scope = enclosing(mod, call, ast.FunctionDef, ast.AsyncFunctionDef)
+            if is_asarray and scope is not None:
+                # traced: no runtime transfer at this site.  The jit
+                # decorator may sit on an OUTER def (a scan/cond body
+                # closure is traced too), so walk the whole chain.
+                fn, traced = scope, False
+                while fn is not None and not traced:
+                    traced = _jit_decorator_info(fn)[0]
+                    fn = enclosing(
+                        mod, fn, ast.FunctionDef, ast.AsyncFunctionDef
+                    )
+                if traced:
+                    continue
+            key = scope if scope is not None else mod.tree
+            if key not in checked:
+                checked[key] = _accounts_h2d(
+                    key, module_level=scope is None
+                )
+            if checked[key]:
+                continue
+            yield Finding(
+                rule="OBS001", severity=SEV_ERROR, path=mod.rel,
+                line=call.lineno, context=mod.context_of(call),
+                message=(
+                    f"{full or cn} without h2d_bytes accounting in the "
+                    "same function — the transfer is invisible to the "
+                    "observability counters (docs/observability.md); "
+                    'trace.add("h2d_bytes", x.nbytes) at issue, or pragma '
+                    "with the downstream accounting site named"
+                ),
+            )

@@ -18,6 +18,7 @@ crdt-enc/src/lib.rs:458-466 and :533-539).
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -26,6 +27,25 @@ from ..models import AddOp, ORSet, RmOp, VClock
 from ..models.counters import NEG, POS
 from ..models.vclock import Dot
 from ..utils import codec
+
+logger = logging.getLogger("crdt_enc_tpu.columnar")
+
+_warned_no_native_state = False
+
+
+def _warn_no_native_state(exc: Exception) -> None:
+    """Log the state-assembly native fallback ONCE per process: losing
+    statebuild.cpp silently costs ~4x on fresh folds and checkpoint
+    unpacks (EXC001 — the bytes_lens_join regression class), but a box
+    that cannot build the C-API library must not warn per call."""
+    global _warned_no_native_state
+    if not _warned_no_native_state:
+        _warned_no_native_state = True
+        logger.warning(
+            "native state assembly unavailable (%r); using the "
+            "numpy/Python fallback for fresh folds and checkpoint "
+            "unpacks", exc
+        )
 
 KIND_ADD = 0
 KIND_RM = 1
@@ -304,7 +324,8 @@ def _orset_fresh_fold_native(
 
     try:
         lib = native.load_state()
-    except Exception:
+    except Exception as e:
+        _warn_no_native_state(e)
         return None
     E, R = len(members), len(replicas)
     kind = np.ascontiguousarray(kind, np.int8)
@@ -549,8 +570,8 @@ def orset_unpack_checkpoint(obj) -> ORSet:
             if rc == 0:
                 return
             target.clear()  # partial native fill: rebuild from scratch
-        except Exception:
-            pass
+        except Exception as e:
+            _warn_no_native_state(e)
         a_l = a_idx.tolist()
         c_l = ctr.tolist()
         starts = np.flatnonzero(np.r_[True, np.diff(m_idx) != 0])

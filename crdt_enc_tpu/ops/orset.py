@@ -26,7 +26,9 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..utils import trace
 from .columnar import KIND_ADD, KIND_RM
 
 
@@ -284,6 +286,10 @@ def orset_merge_many(
     None = pallas on TPU for batches worth streaming, tree elsewhere.
     Merge associativity (tests/test_crdt_laws.py) makes any order legal.
     """
+    # host-resident stacks upload here; device inputs re-wrap for free
+    trace.add("h2d_bytes", sum(
+        x.nbytes for x in (clocks, adds, rms) if isinstance(x, np.ndarray)
+    ))
     c, a, r = jnp.asarray(clocks), jnp.asarray(adds), jnp.asarray(rms)
     if impl is None:
         on_tpu = jax.default_backend() == "tpu"

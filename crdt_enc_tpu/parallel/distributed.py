@@ -47,6 +47,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import ops as K
+from ..utils import trace
 
 logger = logging.getLogger("crdt_enc_tpu.distributed")
 
@@ -217,10 +218,9 @@ def global_op_batch(
         )
         K.pad_orset_rows(cols, target, num_replicas)
         sharding = NamedSharding(mesh, P("dp"))
-        return tuple(
-            jax.device_put(x, sharding)
-            for x in (cols.kind, cols.member, cols.actor, cols.counter)
-        )
+        columns = (cols.kind, cols.member, cols.actor, cols.counter)
+        trace.add("h2d_bytes", sum(x.nbytes for x in columns))
+        return tuple(jax.device_put(x, sharding) for x in columns)
     if dp != procs:
         raise ValueError(
             f"multi-process batches need the dp axis ({dp}) to equal the "
@@ -236,9 +236,13 @@ def global_op_batch(
         rows_per_host = int(np.max(counts))
     K.pad_orset_rows(cols, rows_per_host, num_replicas)
     sharding = NamedSharding(mesh, P("dp"))
+    columns = (cols.kind, cols.member, cols.actor, cols.counter)
+    # this host's shard of the global batch, counted at issue like the
+    # single-process branch (each process counts its own contribution)
+    trace.add("h2d_bytes", sum(x.nbytes for x in columns))
     return tuple(
         jax.make_array_from_process_local_data(sharding, x)
-        for x in (cols.kind, cols.member, cols.actor, cols.counter)
+        for x in columns
     )
 
 
@@ -246,5 +250,7 @@ def replicate(mesh: Mesh, *arrays):
     """Place arrays fully replicated over the mesh (clocks, initial planes
     that are not member-sharded)."""
     sharding = NamedSharding(mesh, P())
-    out = tuple(jax.device_put(np.asarray(a), sharding) for a in arrays)
+    host = tuple(np.asarray(a) for a in arrays)
+    trace.add("h2d_bytes", sum(a.nbytes for a in host))
+    out = tuple(jax.device_put(a, sharding) for a in host)
     return out if len(out) != 1 else out[0]

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import ops as K
 from ..ops.columnar import KIND_ADD, KIND_RM
 from ..ops.counters import sum_wide
+from ..utils import trace
 
 # jax < 0.5 ships shard_map under experimental only, with the replication
 # check named check_rep instead of check_vma; this module-local shim (the
@@ -270,9 +271,14 @@ def sharded_stream_planes(mesh: Mesh, E_pad: int, R: int):
     placed with :func:`stream_sharding` (clock replicated, planes
     mp-sharded).  ``E_pad`` must divide the mp axis."""
     _, clock_s, plane_s = stream_sharding(mesh)
-    clock = jax.device_put(np.zeros(max(R, 1), np.int32), clock_s)
-    add = jax.device_put(np.zeros((E_pad, R), np.int32), plane_s)
-    rm = jax.device_put(np.zeros((E_pad, R), np.int32), plane_s)
+    clock0 = np.zeros(max(R, 1), np.int32)
+    add0 = np.zeros((E_pad, R), np.int32)
+    rm0 = np.zeros((E_pad, R), np.int32)
+    # counted HERE, at issue (OBS001) — callers must not count again
+    trace.add("h2d_bytes", clock0.nbytes + add0.nbytes + rm0.nbytes)
+    clock = jax.device_put(clock0, clock_s)
+    add = jax.device_put(add0, plane_s)
+    rm = jax.device_put(rm0, plane_s)
     return clock, add, rm
 
 
@@ -351,7 +357,7 @@ def gcounter_fold_sharded(mesh: Mesh, clock0, actor, counter):
     """G-Counter fold sharded over ``dp`` (see pncounter_fold_sharded)."""
     sign = np.zeros(len(actor), np.int8)
     p, _, total = pncounter_fold_sharded(
-        mesh, clock0, jnp.zeros_like(jnp.asarray(clock0)), sign, actor, counter
+        mesh, clock0, jnp.zeros_like(clock0), sign, actor, counter
     )
     return p, total  # n-plane is zero, so the pn value IS the sum
 

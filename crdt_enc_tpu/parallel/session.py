@@ -276,7 +276,7 @@ class OrsetFoldSession:
 
                 mp = self.accel.mesh.shape["mp"]
                 self._d_E = -(-self._d_E // mp) * mp
-                trace.add("h2d_bytes", 4 * (self.R + 2 * self._d_E * self.R))
+                # h2d_bytes counted inside sharded_stream_planes, at issue
                 self._d_planes = pmesh.sharded_stream_planes(
                     self.accel.mesh, self._d_E, self.R
                 )
@@ -397,6 +397,11 @@ class OrsetFoldSession:
 
             clock, add, rm = (np.asarray(x) for x in self._d_planes)
             z = np.zeros((E_new - self._d_E, add.shape[1]), np.int32)
+            # the growth re-upload is a real transfer the plane gauges
+            # would otherwise miss (OBS001)
+            trace.add(
+                "h2d_bytes", clock.nbytes + 2 * (add.nbytes + z.nbytes)
+            )
             self._d_planes = (
                 jax.device_put(clock, clock_s),
                 jax.device_put(np.concatenate([add, z]), plane_s),
@@ -452,7 +457,8 @@ class OrsetFoldSession:
         row_s, _, _ = pmesh.stream_sharding(mesh)
 
         def put(x):
-            return jax.device_put(x, row_s)
+            # h2d_bytes counted by fold_chunks_overlapped at chunk issue
+            return jax.device_put(x, row_s)  # lint: disable=OBS001
 
         def fold_step(planes, chunk):
             return step(*planes, *chunk)

@@ -1,0 +1,13 @@
+"""``python -m crdt_enc_tpu.tools.analyze`` — static-analysis CLI.
+
+Thin entry point over :mod:`crdt_enc_tpu.analysis.cli`; see
+docs/static_analysis.md for the rule registry, pragma and baseline
+formats.
+"""
+
+from __future__ import annotations
+
+from ..analysis.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,9 +8,24 @@ normalized before packing.  msgpack's C extension does the heavy lifting.
 
 from __future__ import annotations
 
+import logging
+
 import msgpack
 
+logger = logging.getLogger("crdt_enc_tpu.codec")
+
 _native_pack = None  # resolved lazily; False = unavailable for good
+
+
+def _warn_no_native_pack(exc: Exception) -> None:
+    """The canonical-pack fast path disabling must be VISIBLE (EXC001):
+    a binding regression would otherwise silently put ~400ms back on
+    every canonical_bytes call.  Logged once — the resolution is cached
+    for the process, so the fallback decision happens exactly once too."""
+    logger.warning(
+        "native canon_pack unavailable (%r); using the Python "
+        "canonicalization path for all packs", exc
+    )
 
 
 def pack(obj) -> bytes:
@@ -29,7 +44,8 @@ def pack(obj) -> bytes:
             from .. import native
 
             _native_pack = native.load_state().canon_pack
-        except Exception:
+        except Exception as e:
+            _warn_no_native_pack(e)
             _native_pack = False
     if _native_pack:
         out = _native_pack(obj)

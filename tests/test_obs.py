@@ -16,8 +16,8 @@ Pinned here:
   (the ADVICE-r5 unbounded-recompile bug class, mechanized);
 * **sink**: JSONL round-trip, Prometheus exposition, Core.compact
   wiring;
-* **registry lint**: every span/metric name in the tree is registered in
-  docs/observability.md (tools/check_span_names.py).
+The span-name registry lint lives in the static-analysis engine now
+(rule SPN001, gated by tests/test_static_analysis.py).
 """
 
 from __future__ import annotations
@@ -535,29 +535,7 @@ def test_obs_report_export_trace_requires_events(tmp_path, capsys):
     assert "no event log" in capsys.readouterr().err
 
 
-def _load_tool(name: str):
-    import importlib.util
-    import pathlib
-
-    root = pathlib.Path(__file__).resolve().parent.parent
-    spec = importlib.util.spec_from_file_location(
-        name, root / "tools" / f"{name}.py"
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def test_span_names_are_registered():
-    """tools/check_span_names.py: every literal trace.span/add/gauge/
-    observe name in the tree is registered in docs/observability.md —
-    and every registered stream.* proof span has a call site."""
-    assert _load_tool("check_span_names").main([]) == 0
-
-
-def test_thread_discipline():
-    """tools/check_thread_discipline.py: no bare threading.Thread
-    construction outside run_ingest_pipeline (and the allowlisted
-    non-ingest sites) — parallel ingest must ride the pipeline's
-    backpressure/cancellation/observability contract."""
-    assert _load_tool("check_thread_discipline").main([]) == 0
+# The span-name registry and thread-discipline lints moved into the
+# static-analysis engine (rules SPN001/THR001); the tier-1 gate is now
+# tests/test_static_analysis.py::test_live_repo_analysis_clean_within_budget
+# (plus the shim exit-code tests there).

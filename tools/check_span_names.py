@@ -1,139 +1,28 @@
 #!/usr/bin/env python
-"""Lint: every span/metric name used by library code must be registered.
+"""Lint shim: span/metric names vs the observability registry.
 
-The observability registry lives in docs/observability.md (the two
-tables under "## Span registry" and "## Counter & gauge registry").
-This script greps the tree for literal ``trace.span(`` / ``trace.add(``
-/ ``trace.gauge(`` / ``trace.observe(`` call sites (plus ``record.*``,
-the obs-internal spelling) and fails when
-
-* a name used in code is missing from the registry (undocumented
-  metric), or
-* a call site passes a *dynamic* (f-string) name — names key the
-  aggregate table and must stay low-cardinality literals.
-
-Registry entries no longer present in code are reported as warnings
-(stale doc rows) without failing, so conditionally-compiled call sites
-don't break CI — EXCEPT the ``stream.*`` pipeline family (which
-includes the fan-out's ``stream.producer.*`` lanes): those spans are
-load-bearing for the overlap/backpressure proofs the streaming tests
-and ``obs_report --check-overlap`` read, so a registered ``stream.*``
-name with no call site is an ERROR (the proof would silently read an
-empty timeline).  ``tests/`` is exempt (scratch names).  Run directly
-or via the tier-1 suite (tests/test_obs.py).
+The check itself moved into the static-analysis engine as rule SPN001
+(crdt_enc_tpu/analysis/rules/spans.py — same invariants: every literal
+``trace.span/add/gauge/observe`` name registered in
+docs/observability.md, registered ``stream.*`` proof spans must have a
+call site).  This shim keeps the historical CLI and exit codes (0 clean,
+1 violations) for existing invocations; prefer
+``python -m crdt_enc_tpu.tools.analyze --rule SPN001``.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-DOC = ROOT / "docs" / "observability.md"
-
-SCAN_GLOBS = [
-    ("crdt_enc_tpu", "**/*.py"),
-    ("benchmarks", "**/*.py"),
-    ("examples", "**/*.py"),
-    (".", "bench.py"),
-]
-
-CALL_RE = re.compile(
-    r"\b(?:trace|record|_record)\.(span|add|gauge|observe)\(\s*"
-    r"(?:(f)?(['\"])([^'\"]+)\3|([A-Za-z_][\w.]*))"
-)
-
-TABLE_ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
-
-
-REGISTRY_SECTIONS = ("## Span registry", "## Counter & gauge registry")
-
-
-def registry_names() -> set[str]:
-    """Backticked first-column names from the registry tables ONLY —
-    other tables in the doc (module overview etc.) are not a registry."""
-    names: set[str] = set()
-    in_registry = False
-    for line in DOC.read_text().splitlines():
-        if line.startswith("## "):
-            in_registry = line.strip() in REGISTRY_SECTIONS
-            continue
-        if not in_registry:
-            continue
-        m = TABLE_ROW_RE.match(line)
-        if m:
-            names.add(m.group(1))
-    return names
-
-
-def scan_calls():
-    """Yield (path, lineno, kind, name, dynamic) for every call site."""
-    for base, pattern in SCAN_GLOBS:
-        for path in sorted((ROOT / base).glob(pattern)):
-            rel = path.relative_to(ROOT)
-            text = path.read_text()
-            for m in CALL_RE.finditer(text):
-                lineno = text.count("\n", 0, m.start()) + 1
-                kind, fpref, _q, literal, ident = (
-                    m.group(1), m.group(2), m.group(3), m.group(4),
-                    m.group(5),
-                )
-                if literal is not None and not fpref:
-                    yield rel, lineno, kind, literal, False
-                else:
-                    yield rel, lineno, kind, ident or literal, True
 
 
 def main(argv=None) -> int:
-    if not DOC.exists():
-        print(f"missing registry doc: {DOC}", file=sys.stderr)
-        return 1
-    registered = registry_names()
-    if not registered:
-        print("docs/observability.md has no registry tables", file=sys.stderr)
-        return 1
-    used: set[str] = set()
-    errors = 0
-    for rel, lineno, kind, name, dynamic in scan_calls():
-        if dynamic:
-            # a variable name is fine when the VALUES are registered
-            # literals defined nearby; flag only f-strings (true dynamic
-            # cardinality) — identifiers get a warning
-            print(f"WARN {rel}:{lineno}: non-literal {kind} name ({name})")
-            continue
-        used.add(name)
-        if name not in registered:
-            print(
-                f"ERROR {rel}:{lineno}: {kind}(\"{name}\") is not in the "
-                "docs/observability.md registry"
-            )
-            errors += 1
-    # names maintained inside obs.record itself (no trace.* call site)
-    internal = {"events_dropped"}
-    # the streaming-pipeline family backs machine-checked proofs
-    # (chunk_overlaps, the seam/backpressure tests): a registered
-    # stream.* name that nothing emits means a proof reads nothing
-    PROOF_PREFIXES = ("stream.",)
-    for stale in sorted(registered - used - internal):
-        if stale.startswith(PROOF_PREFIXES):
-            print(
-                f"ERROR registry entry `{stale}` ({PROOF_PREFIXES[0]}* "
-                "family) has no literal call site — the overlap proofs "
-                "would read an empty timeline"
-            )
-            errors += 1
-            continue
-        print(f"WARN registry entry `{stale}` has no literal call site")
-    if errors:
-        print(
-            f"{errors} registry violation(s) — unregistered names and/or "
-            "call-site-less stream.* proof spans, see ERROR lines",
-            file=sys.stderr,
-        )
-        return 1
-    print(f"OK: {len(used)} names used, all registered")
-    return 0
+    sys.path.insert(0, str(ROOT))
+    from crdt_enc_tpu.analysis.cli import main as analyze
+
+    return analyze(["--rule", "SPN001", "--root", str(ROOT)])
 
 
 if __name__ == "__main__":

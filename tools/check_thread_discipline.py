@@ -1,98 +1,28 @@
 #!/usr/bin/env python
-"""Lint: no bare ``threading.Thread`` construction outside the ingest
-pipeline.
+"""Lint shim: no bare ``threading.Thread`` outside the ingest pipeline.
 
-Ad-hoc threads bypass everything the fan-out pipeline guarantees —
-backpressure (the BoundedSemaphore memory bound), ordered sequencing,
-fault propagation (first failure cancels the peers, threads are joined),
-and per-lane observability (numbered producer lanes, the
-``stream_producers`` gauge).  Every parallel ingest in library code must
-therefore go through ``ops/stream.py run_ingest_pipeline``; the few
-legitimate exceptions are enumerated in :data:`ALLOWED` with the reason
-they are not ingest work.
-
-Scans ``crdt_enc_tpu/``, ``benchmarks/``, and ``examples/`` for
-``threading.Thread(`` call sites (``bench.py``'s watchdog is a
-measurement-harness guard, also allowlisted).  Exits 1 on any
-non-allowlisted site.  Run directly or via the tier-1 suite
-(tests/test_obs.py).
+The check itself moved into the static-analysis engine as rule THR001
+(crdt_enc_tpu/analysis/rules/threads.py); the old per-file allowlist
+with pinned site counts became ``max``-pinned entries in
+tools/analysis_baseline.toml — same semantics: a NEW bare thread in an
+allowlisted file exceeds the pin and fails.  This shim keeps the
+historical CLI and exit codes (0 clean, 1 violations); prefer
+``python -m crdt_enc_tpu.tools.analyze --rule THR001``.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-SCAN_GLOBS = [
-    ("crdt_enc_tpu", "**/*.py"),
-    ("benchmarks", "**/*.py"),
-    ("examples", "**/*.py"),
-    (".", "bench.py"),
-]
-
-# path (repo-relative, posix) -> (max Thread call sites, reason).  The
-# count is pinned so a NEW bare thread added to an allowlisted file still
-# fails — the allowlist covers the existing sites, not the whole file.
-ALLOWED = {
-    "crdt_enc_tpu/ops/stream.py": (
-        1, "run_ingest_pipeline itself — the one sanctioned producer pool"
-    ),
-    "crdt_enc_tpu/backends/gpg_keys.py": (
-        1, "stderr drain of a gpg subprocess; no ingest work, no backpressure"
-    ),
-    "bench.py": (
-        1, "backend-init watchdog: force-exits a hung TPU-tunnel probe"
-    ),
-}
-
-THREAD_RE = re.compile(r"\bthreading\.Thread\(")
-
-
-def scan():
-    """Yield (relpath, lineno) for every threading.Thread( call site."""
-    for base, pattern in SCAN_GLOBS:
-        for path in sorted((ROOT / base).glob(pattern)):
-            rel = path.relative_to(ROOT).as_posix()
-            for lineno, line in enumerate(
-                path.read_text().splitlines(), start=1
-            ):
-                if THREAD_RE.search(line):
-                    yield rel, lineno
-
 
 def main(argv=None) -> int:
-    errors = 0
-    counts: dict[str, list[int]] = {}
-    for rel, lineno in scan():
-        if rel in ALLOWED:
-            counts.setdefault(rel, []).append(lineno)
-            continue
-        print(
-            f"ERROR {rel}:{lineno}: bare threading.Thread outside "
-            "run_ingest_pipeline — route parallel ingest through "
-            "ops/stream.py (or add an ALLOWED entry with a reason)"
-        )
-        errors += 1
-    for rel, linenos in sorted(counts.items()):
-        limit = ALLOWED[rel][0]
-        if len(linenos) > limit:
-            print(
-                f"ERROR {rel}: {len(linenos)} Thread call sites at lines "
-                f"{linenos}, allowlist covers only {limit} — a new bare "
-                "thread was added to an allowlisted file"
-            )
-            errors += 1
-    for rel in sorted(set(ALLOWED) - set(counts)):
-        print(f"WARN allowlist entry `{rel}` has no Thread call site")
-    if errors:
-        print(f"{errors} undisciplined thread site(s)", file=sys.stderr)
-        return 1
-    n_sites = sum(len(v) for v in counts.values())
-    print(f"OK: {n_sites} allowlisted site(s), no bare threads")
-    return 0
+    sys.path.insert(0, str(ROOT))
+    from crdt_enc_tpu.analysis.cli import main as analyze
+
+    return analyze(["--rule", "THR001", "--root", str(ROOT)])
 
 
 if __name__ == "__main__":

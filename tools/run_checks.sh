@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Single entry point for the repo's correctness tooling (docs/static_analysis.md).
+#
+#   tools/run_checks.sh            # analysis + shims + parity count check
+#
+# Exit non-zero on the first failing check.  The same gates run from
+# tier-1 via tests/test_static_analysis.py (engine clean on live repo)
+# and tests/test_parity_count.py (doc count matches collection).
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== static analysis (all rules, baseline diff) =="
+python -m crdt_enc_tpu.tools.analyze --diff-baseline
+
+echo "== span-name registry shim =="
+python tools/check_span_names.py
+
+echo "== thread-discipline shim =="
+python tools/check_thread_discipline.py
+
+echo "== parity count =="
+python - <<'EOF'
+import pathlib
+import re
+import sys
+
+sys.path.insert(0, "tools")
+from update_parity_count import COUNT_RE, PARITY, collected_count
+
+doc = COUNT_RE.search(PARITY.read_text())
+live = collected_count()
+if doc is None:
+    raise SystemExit("docs/PARITY.md row 12 lost its test-count marker")
+if int(doc.group(2)) != live:
+    raise SystemExit(
+        f"docs/PARITY.md says {doc.group(2)} tests, collection says {live} "
+        "— run tools/update_parity_count.py"
+    )
+print(f"OK: {live} tests")
+EOF
+
+echo "== all checks passed =="
