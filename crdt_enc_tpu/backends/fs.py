@@ -236,6 +236,24 @@ class FsStorage(Storage):
         def __init__(self, version: int):
             self.version = version
 
+    def _scan_sizes_native(self, lib, d: bytes, v: int):
+        """One bounded native size-only pass (``scan_op_sizes``): the
+        dense per-file sizes from version ``v``, as ``(sizes[:n],
+        exhausted)`` — ``exhausted`` means the directory ran out inside
+        this round.  The single encoding of the native scan calling
+        convention; the bulk reader and ``stat_ops`` both build on it."""
+        import ctypes
+
+        import numpy as np
+
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        sizes = np.zeros(self.NATIVE_SCAN_BATCH, np.int64)
+        n = int(lib.scan_op_sizes(
+            d, v, self.NATIVE_SCAN_BATCH, sizes.ctypes.data_as(i64p)
+        ))
+        n = max(n, 0)
+        return sizes[:n], n < self.NATIVE_SCAN_BATCH
+
     def _scan_round_native(self, lib, d: bytes, actor: Actor, v: int, max_bytes: int):
         """One bounded native round.  Returns ``(files, next_v, done)``;
         raises :class:`_ScanRace` on a mid-round race (nothing consumed)
@@ -247,14 +265,11 @@ class FsStorage(Storage):
         from .. import native
 
         i64p = ctypes.POINTER(ctypes.c_int64)
-        sizes = np.zeros(self.NATIVE_SCAN_BATCH, np.int64)
-        n = int(lib.scan_op_sizes(
-            d, v, self.NATIVE_SCAN_BATCH, sizes.ctypes.data_as(i64p)
-        ))
-        if n <= 0:
+        sizes, exhausted = self._scan_sizes_native(lib, d, v)
+        n = len(sizes)
+        if n == 0:
             return [], v, True
         scanned = n
-        sizes = sizes[:n]
         # byte cap: shrink this round to the prefix that fits (but always
         # take at least one file so progress is guaranteed)
         cum = np.cumsum(sizes)
@@ -284,7 +299,7 @@ class FsStorage(Storage):
             )
             for i in range(n)
         ]
-        done = scanned < self.NATIVE_SCAN_BATCH and n == scanned
+        done = exhausted and n == scanned
         return files, v + n, done
 
     @staticmethod
@@ -508,6 +523,53 @@ class FsStorage(Storage):
                 if raw is None:
                     return out
                 out.append((actor, v, raw))
+                v += 1
+
+        per_actor = await asyncio.gather(
+            *(self._run(scan, a, f) for a, f in actor_first_versions)
+        )
+        return [item for chunk in per_actor for item in chunk]
+
+    async def stat_ops(
+        self, actor_first_versions: list[tuple[Actor, int]]
+    ) -> list[tuple[Actor, int, int]]:
+        """Dense tail sizing for the replication-status backlog probe:
+        the native ``scan_op_sizes`` pass (one C call per round — the
+        same first pass the bulk reader uses, without the read), with a
+        per-file ``os.stat`` continuation when the native path is
+        unavailable.  Probe-prefiltered like ``load_ops``, so a fully
+        consumed log costs one stat per actor, not a scan."""
+        actor_first_versions = await self._run(
+            self._probe_actors, actor_first_versions
+        )
+
+        def scan(actor: Actor, first: int) -> list[tuple[Actor, int, int]]:
+            out: list[tuple[Actor, int, int]] = []
+            v = first
+            try:
+                from .. import native
+
+                lib = native.load()
+                d = self._ops_dir(actor).encode()
+                while True:
+                    sizes, exhausted = self._scan_sizes_native(lib, d, v)
+                    out.extend(
+                        (actor, v + i, int(s)) for i, s in enumerate(sizes)
+                    )
+                    v += len(sizes)
+                    if exhausted:
+                        return out
+            except Exception:
+                self._warn_native_unavailable()
+            # per-file stat continuation from wherever the native pass
+            # stopped (or from ``first`` when it never started)
+            dd = self._ops_dir(actor)
+            while True:
+                try:
+                    st = os.stat(os.path.join(dd, str(v)))
+                except OSError:
+                    return out
+                out.append((actor, v, int(st.st_size)))
                 v += 1
 
         per_actor = await asyncio.gather(

@@ -99,6 +99,18 @@ class MemoryStorage(Storage):
                 v += 1
         return out
 
+    async def stat_ops(
+        self, actor_first_versions: list[tuple[Actor, int]]
+    ) -> list[tuple[Actor, int, int]]:
+        out = []
+        for actor, first in actor_first_versions:
+            log = self.remote.ops.get(actor, {})
+            v = first
+            while v in log:
+                out.append((actor, v, len(log[v])))
+                v += 1
+        return out
+
     async def store_ops(self, actor: Actor, version: int, data: bytes) -> None:
         log = self.remote.ops.setdefault(actor, {})
         if version in log:

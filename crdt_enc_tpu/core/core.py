@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import os
 import uuid
 from dataclasses import dataclass, field
 
@@ -131,10 +132,37 @@ class RemoteMeta:
 @dataclass
 class StateWrapper:
     """A full-state snapshot: the CRDT value + the op-log cursor (VClock of
-    last applied op-file versions — the resume point, lib.rs:740-743)."""
+    last applied op-file versions — the resume point, lib.rs:740-743).
+
+    On the wire a snapshot payload is ``[state, cursor]`` or (since the
+    replication-observability layer) ``[state, cursor, sealer_actor]`` —
+    the sealing replica's id, which lets readers attribute the cursor to
+    a replica and maintain the cursor matrix behind the causal stability
+    watermark (obs/replication.py).  Readers tolerate both lengths, so
+    pre-existing remotes stay readable and old *core* readers (which
+    index ``[0]``/``[1]``) never notice the extra element — but the
+    pre-replication ``tools/fsck`` hard-checks ``len == 2`` and reports
+    every 3-element snapshot as corruption, so upgrade fsck installs
+    before producers start sealing the 3-form."""
 
     state: object
     next_op_versions: VClock
+
+
+def snapshot_sealer(obj) -> bytes | None:
+    """The validated sealer id from a decoded snapshot wrapper, or
+    ``None`` when absent or malformed — the single encoding of the
+    sealer wire rule (16-byte actor id in slot 2).  The type check
+    matters: ``bytes(16)`` would coerce an integer into 16 NUL bytes —
+    a phantom all-zero replica.  Core ingest silently drops what this
+    rejects (observational, never a read failure); fsck reports it."""
+    sealer = obj[2] if len(obj) > 2 else None
+    if (
+        isinstance(sealer, (bytes, bytearray, memoryview))
+        and len(sealer) == 16
+    ):
+        return bytes(sealer)
+    return None
 
 
 @dataclass
@@ -220,6 +248,11 @@ class _MutData:
         self.read_metas: set[str] = set()
         self.remote_meta = RemoteMeta()
         self.keys = Keys()
+        # cursor matrix: other replicas' last PUBLISHED ingest cursors,
+        # learned from the sealer id + cursor each compacted snapshot
+        # carries (obs/replication.py).  Monotone (clocks only merge) and
+        # purely observational — convergence never depends on it.
+        self.cursor_matrix: dict[Actor, VClock] = {}
 
 
 class Core:
@@ -251,6 +284,11 @@ class Core:
         # if not (one existed but was rejected), why
         self.opened_from_checkpoint = False
         self.checkpoint_fallback_reason: str | None = None
+        # replication-status sampling (obs/replication.py) runs on every
+        # open/read_remote/compact unless opted out; the last computed
+        # status is kept for callers that want the full dict
+        self._repl_sample = os.environ.get("CRDT_REPL_SAMPLE", "") != "0"
+        self.last_replication_status: dict | None = None
 
     # ------------------------------------------------------------------ open
     @classmethod
@@ -292,6 +330,9 @@ class Core:
                 )
         if opts.checkpoint:
             await core._open_from_checkpoint()
+        # replication status at open: the backlog gauge here is the
+        # answer to "how much will the first read_remote have to fold?"
+        await core._sample_replication()
         return core
 
     # -------------------------------------------------------------- identity
@@ -318,6 +359,71 @@ class Core:
         section (the Python shape of holding the lock across an await)
         raises instead of racing; awaitable returns are rejected."""
         return LockBox(self._data.state).with_(fn)
+
+    # ------------------------------------------------------- replication obs
+    async def replication_status(self, *, _backlog: list | None = None) -> dict:
+        """This replica's replication/convergence status: the causal
+        stability watermark, per-actor op backlog (files + bytes past
+        the local cursor, sized without reading — ``Storage.stat_ops``),
+        divergence vs. everything known to exist, and checkpoint
+        staleness.  Pure observation — no state is mutated, no op
+        payload is read, and the math lives in
+        :func:`crdt_enc_tpu.obs.replication.compute_status` (exactly
+        unit-tested); this method only gathers its inputs.  The result
+        is byte-stable under ``json.dumps(..., sort_keys=True)`` for a
+        given replica state.
+
+        ``_backlog`` is the post-ingest fast path: read_remote just
+        folded everything its own listing found, so its sample passes
+        ``[]`` instead of paying a second per-actor storage probe on
+        the polling hot path (ops sealed concurrently with the fold
+        surface in the next sample)."""
+        from ..obs import replication
+
+        with trace.span("repl.status"):
+            d = self._data
+            if _backlog is None:
+                actors = await self.storage.list_op_actors()
+                wanted = [
+                    (a, d.next_op_versions.get(a) + 1) for a in sorted(actors)
+                ]
+                backlog = (
+                    await self.storage.stat_ops(wanted) if wanted else []
+                )
+            else:
+                backlog = _backlog
+            # sync section: clocks snapshot + compute, no await between
+            ckpt = self._checkpoint_sig
+            status = replication.compute_status(
+                self.actor_id,
+                d.next_op_versions.copy(),
+                {a: c.copy() for a, c in d.cursor_matrix.items()},
+                backlog,
+                self._remote_id(),
+                dict(ckpt[0]) if ckpt is not None else None,
+                self._checkpoint_enabled,
+            )
+        self.last_replication_status = status
+        return status
+
+    async def _sample_replication(
+        self, *, _backlog: list | None = None
+    ) -> dict | None:
+        """Status → registered gauges (obs.replication.sample) on every
+        open / read_remote / compact; ``CRDT_REPL_SAMPLE=0`` opts out.
+        Observability must never kill the run it observes: a failed
+        probe logs at debug and samples nothing."""
+        if not self._repl_sample:
+            return None
+        from ..obs import replication
+
+        try:
+            status = await self.replication_status(_backlog=_backlog)
+        except Exception:
+            logger.debug("replication status sampling failed", exc_info=True)
+            return None
+        replication.sample(status)
+        return status
 
     # ----------------------------------------------------------- key rotation
     async def _install_new_key(self) -> Key:
@@ -365,10 +471,17 @@ class Core:
             b"id": self.actor_id,
             b"dv": self.current_data_version,
             b"key": latest.id if latest is not None else b"",
-            b"meta": hashlib.sha3_256(
-                codec.pack(d.remote_meta.to_obj())
-            ).digest(),
+            b"meta": self._remote_id(),
         }
+
+    def _remote_id(self) -> bytes:
+        """SHA3 of the canonical converged RemoteMeta — the stable
+        identity of the remote this replica is attached to.  Doubles as
+        the checkpoint fingerprint's meta hash and the ``remote_id`` the
+        replication status / fleet aggregator group devices by."""
+        return hashlib.sha3_256(
+            codec.pack(self._data.remote_meta.to_obj())
+        ).digest()
 
     def _pack_checkpoint_state(self):
         """(fmt, obj) for the current state: the packed-columnar ORSet
@@ -412,6 +525,12 @@ class Core:
                 b"cursor": d.next_op_versions.to_obj(),
                 b"rs": sorted(d.read_states),
                 b"fp": self._checkpoint_fingerprint(),
+                # the cursor matrix rides along so a warm open keeps its
+                # replication view (stability watermark continuity);
+                # observational only — never part of the fingerprint
+                b"cm": {
+                    a: c.to_obj() for a, c in sorted(d.cursor_matrix.items())
+                },
             }
             blob = await self._seal(payload)
             await self.storage.store_local_checkpoint(blob)
@@ -459,6 +578,10 @@ class Core:
                     fmt = int(obj[b"fmt"])
                     cursor = VClock.from_obj(obj[b"cursor"])
                     read_states = {str(n) for n in obj[b"rs"]}
+                    cursor_matrix = {
+                        bytes(a): VClock.from_obj(c)
+                        for a, c in (obj.get(b"cm") or {}).items()
+                    }
                 except Exception:
                     logger.debug("checkpoint malformed", exc_info=True)
                     return await self._checkpoint_fallback("malformed")
@@ -500,6 +623,7 @@ class Core:
             d.state = state
             d.next_op_versions = cursor
             d.read_states = read_states
+            d.cursor_matrix = cursor_matrix
             # the installed resume point IS the last sealed one: a quiet
             # first poll under checkpoint_on_read must not reseal it
             self._checkpoint_sig = (
@@ -593,9 +717,11 @@ class Core:
         self._data.next_op_versions.apply(Dot(actor, version))
 
     # ----------------------------------------------------------- read_remote
-    async def read_remote(self) -> None:
+    async def read_remote(self, *, _sample: bool = True) -> None:
         """Ingest everything new: snapshots first, then op tails
-        (consumer path, lib.rs:390-399)."""
+        (consumer path, lib.rs:390-399).  ``_sample=False`` is compact's
+        internal call — it samples once itself, post-GC, so the inner
+        ingest must not pay a second status probe."""
         await self._read_remote_meta()
         await self._read_remote_states()
         await self._read_remote_ops()
@@ -610,6 +736,11 @@ class Core:
             )
             if sig != self._checkpoint_sig:
                 await self.save_checkpoint()
+        if _sample:
+            # the ingest above folded everything its own listing found,
+            # so the backlog is empty as-of that listing — don't pay a
+            # second per-actor storage probe on the polling hot path
+            await self._sample_replication(_backlog=[])
 
     async def _read_remote_states(self) -> None:
         with trace.span("states.list"):
@@ -624,22 +755,32 @@ class Core:
         async def decode(name: str, raw: bytes):
             async with sem:
                 obj = await self._open_sealed(raw)
-                return name, StateWrapper(
+                # [state, cursor] or [state, cursor, sealer] — see
+                # StateWrapper's wire note; a malformed sealer id is
+                # ignored (observational), never a read failure
+                sealer = snapshot_sealer(obj)
+                return name, sealer, StateWrapper(
                     self.adapter.state_from_obj(obj[0]), VClock.from_obj(obj[1])
                 )
 
         with trace.span("states.decrypt_decode"):
             decoded = await asyncio.gather(*(decode(n, raw) for n, raw in loaded))
         # sync section: CvRDT merge (HOT LOOP #1 → accelerator)
-        wrappers = [sw for _, sw in decoded]
+        wrappers = [sw for _, _, sw in decoded]
         with trace.span("states.merge"):
             self.accel.merge_states(
                 self._data.state, [sw.state for sw in wrappers]
             )
         trace.add("states_merged", len(wrappers))
-        for _, sw in decoded:
+        for _, sealer, sw in decoded:
             self._data.next_op_versions.merge(sw.next_op_versions)
-        self._data.read_states.update(name for name, _ in decoded)
+            if sealer is not None and sealer != self.actor_id:
+                # learn the sealing replica's published ingest cursor —
+                # the matrix row the stability watermark mins over
+                self._data.cursor_matrix.setdefault(
+                    sealer, VClock()
+                ).merge(sw.next_op_versions)
+        self._data.read_states.update(name for name, _, _ in decoded)
 
     async def _read_remote_ops(self) -> None:
         with trace.span("ops.list"):
@@ -1123,12 +1264,16 @@ class Core:
         while chunk k folds, with per-stage ``stream.*`` trace spans —
         see docs/streaming_pipeline.md for how to read them."""
         with trace.span("compact.ingest"):
-            await self.read_remote()
+            await self.read_remote(_sample=False)
         # sync snapshot section
         d = self._data
         payload = [
             self.adapter.state_to_obj(d.state),
             d.next_op_versions.to_obj(),
+            # sealer id: readers attribute the cursor to this replica in
+            # their cursor matrix (StateWrapper's wire note) — old
+            # readers index [0]/[1] and never see it
+            self.actor_id,
         ]
         states_to_remove = sorted(d.read_states)
         ops_to_remove = sorted(d.next_op_versions.counters.items())
@@ -1153,6 +1298,11 @@ class Core:
             await self.save_checkpoint()
         # local ops are now folded into the snapshot; reset the producer
         # cursor bookkeeping is unnecessary — versions only grow.
+        # replication status AFTER the GC + checkpoint seal (backlog is
+        # zero by construction, staleness zero): the post-compaction
+        # fixed point is what rides into the sink record below — the
+        # per-device line the fleet aggregator reads.
+        status = await self._sample_replication()
         # run-scoped metrics sink (CRDT_OBS_SINK / obs.sink.configure):
         # every compaction appends its phase table + counters, so the
         # streaming pipeline is auditable after the process is gone.
@@ -1170,6 +1320,7 @@ class Core:
                 "compact",
                 {"gc_op_actors": len(ops_to_remove),
                  "gc_states": len(states_to_remove)},
+                status,
             )
 
     # ------------------------------------------------- remote meta lifecycle

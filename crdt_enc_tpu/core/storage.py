@@ -111,6 +111,22 @@ class Storage(ABC):
         if chunk:
             yield chunk
 
+    async def stat_ops(
+        self, actor_first_versions: list[tuple[Actor, int]]
+    ) -> list[tuple[Actor, int, int]]:
+        """Like ``load_ops`` but returns ``(actor, version, nbytes)`` —
+        sizes without content, the replication-status backlog probe
+        (obs/replication.py).  Same dense-scan contract as ``load_ops``.
+        This base implementation degrades to loading (correct anywhere);
+        backends with cheap metadata (fs: stat, memory: dict walk)
+        override it so status sampling never reads op payloads."""
+        return [
+            (actor, version, len(raw))
+            for actor, version, raw in await self.load_ops(
+                actor_first_versions
+            )
+        ]
+
     @abstractmethod
     async def store_ops(self, actor: Actor, version: int, data: bytes) -> None: ...
 

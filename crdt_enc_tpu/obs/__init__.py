@@ -15,14 +15,23 @@ is the full layer on top of the span/counter registry PR 1 seeded:
 * :mod:`.runtime`  — JAX runtime signals: XLA recompile counting via
   ``jax.monitoring``, H2D transfer accounting, device memory gauges
   sampled at fold boundaries.
-* :mod:`.sink`     — run-scoped JSONL metrics sink (``CRDT_OBS_SINK``)
-  and Prometheus-style text exposition.
+* :mod:`.sink`     — run-scoped JSONL metrics sink (``CRDT_OBS_SINK``,
+  schema-stamped, size-rotated) and Prometheus text exposition with
+  registry-derived ``# HELP``/``# TYPE``.
+* :mod:`.replication` — per-device replication/convergence status
+  (ISSUE 6): causal stability watermark, per-actor op backlog,
+  divergence and checkpoint-staleness gauges, sampled by the core on
+  every open/read_remote/compact.
+* :mod:`.fleet`    — cross-device aggregation of sink files: fleet
+  stable watermark, convergence-lag distribution, backlog quantiles,
+  and the BENCH_LOCAL perf-trend table with regression flagging.
 
 CLI: ``python -m crdt_enc_tpu.tools.obs_report`` renders phase tables,
-exports timelines, and diffs runs.  Span/metric names are registered in
+exports timelines, diffs runs, and aggregates fleets
+(``fleet``/``trend``).  Span/metric names are registered in
 ``docs/observability.md`` and linted by ``tools/check_span_names.py``.
 """
 
-from . import record, runtime, sink, timeline
+from . import fleet, record, replication, runtime, sink, timeline
 
-__all__ = ["record", "runtime", "sink", "timeline"]
+__all__ = ["fleet", "record", "replication", "runtime", "sink", "timeline"]

@@ -21,7 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..backends.memory import content_name
-from ..core.core import RemoteMeta
+from ..core.core import RemoteMeta, snapshot_sealer
 from ..core.key_cryptor import Keys
 from ..utils import VersionBytes, codec, trace
 from ..utils.versions import SUPPORTED_CONTAINER_VERSIONS
@@ -161,8 +161,17 @@ async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> Fs
                 continue
             try:
                 obj = await open_sealed(raw)
-                if not (isinstance(obj, (list, tuple)) and len(obj) == 2):
-                    raise ValueError("state wrapper is not [state, cursor]")
+                # [state, cursor] or [state, cursor, sealer] — the
+                # replication-obs layer appends the sealing replica's
+                # actor id (StateWrapper's wire note in core/core.py)
+                if not (isinstance(obj, (list, tuple)) and len(obj) in (2, 3)):
+                    raise ValueError(
+                        "state wrapper is not [state, cursor(, sealer)]"
+                    )
+                # same wire rule core ingest applies — but where core
+                # silently drops a malformed sealer, fsck reports it
+                if len(obj) == 3 and obj[2] and snapshot_sealer(obj) is None:
+                    raise ValueError("snapshot sealer id is not 16 bytes")
             except Exception as e:
                 report.add("error", "states", name, f"{e}")
 

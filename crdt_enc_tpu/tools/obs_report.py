@@ -1,4 +1,4 @@
-"""Observability CLI: phase tables, timeline export, run diffs.
+"""Observability CLI: phase tables, timelines, diffs, fleet reports.
 
 Consumes the JSONL files the metrics sink writes (``obs.sink``, env
 ``CRDT_OBS_SINK``) and the obs snapshots embedded in
@@ -8,7 +8,10 @@ Consumes the JSONL files the metrics sink writes (``obs.sink``, env
     python -m crdt_enc_tpu.tools.obs_report export-trace RUN.jsonl \\
         -o trace.json [--check-overlap stream.ingest:stream.reduce]
     python -m crdt_enc_tpu.tools.obs_report diff OLD.jsonl NEW.jsonl
-    python -m crdt_enc_tpu.tools.obs_report prom RUN.jsonl
+    python -m crdt_enc_tpu.tools.obs_report prom RUN.jsonl [--timestamp]
+    python -m crdt_enc_tpu.tools.obs_report fleet DEV1.jsonl DEV2.jsonl ...
+    python -m crdt_enc_tpu.tools.obs_report trend BENCH_LOCAL.jsonl \\
+        [--metric M] [--fail-on-regression PCT]
 
 * **report** — the per-phase table (totals, counts, p50/p95/p99/max)
   plus counters and gauges for one record.
@@ -19,7 +22,17 @@ Consumes the JSONL files the metrics sink writes (``obs.sink``, env
   mechanized (exit 1 when the recorded run was serialized).
 * **diff** — phase-by-phase seconds/count/quantile deltas between two
   runs (regression triage: which stage got slower, by how much).
-* **prom** — the record in Prometheus text exposition format.
+* **prom** — the record in Prometheus text exposition format
+  (``# HELP``/``# TYPE`` per family; ``--timestamp`` stamps samples
+  with the record's ``ts``).
+* **fleet** — merge several devices' sink files (``obs.fleet``): the
+  fleet stable watermark, per-device convergence lag distribution, and
+  backlog quantiles, grouped by remote.  Exit 2 when an input cannot
+  contribute (no replication record, unreadable sink schema).
+* **trend** — the per-config ops/s trajectory over BENCH_LOCAL.jsonl;
+  ``--fail-on-regression PCT`` exits 1 when any config's latest run is
+  more than PCT percent below its best earlier run — the CI gate that
+  makes perf regressions visible instead of living only in the JSONL.
 
 Record selection: ``--label`` filters by snapshot label, ``--index``
 picks among matches (default -1, the newest).  Records without the
@@ -33,25 +46,13 @@ import argparse
 import json
 import sys
 
+from ..obs import fleet as obs_fleet
 from ..obs import record as obs_record
 from ..obs import sink as obs_sink
 from ..obs import timeline as obs_timeline
 
-
-def load_records(path: str) -> list[dict]:
-    records = []
-    with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if not ln:
-                continue
-            try:
-                rec = json.loads(ln)
-            except ValueError:
-                continue  # truncated final append from a killed run
-            if isinstance(rec, dict):
-                records.append(rec)
-    return records
+# one parse for the file format, shared with obs.fleet (obs.sink owns it)
+load_records = obs_sink.read_records
 
 
 def pick_record(records: list[dict], label: str | None, index: int) -> dict:
@@ -87,7 +88,50 @@ def cmd_report(args) -> int:
 
 def cmd_prom(args) -> int:
     rec = pick_record(load_records(args.file), args.label, args.index)
-    sys.stdout.write(obs_sink.to_prometheus(rec))
+    ts = rec.get("ts") if args.timestamp else None
+    sys.stdout.write(obs_sink.to_prometheus(rec, timestamp=ts))
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    try:
+        summaries = obs_fleet.device_summaries(args.files)
+    except (obs_fleet.FleetInputError, obs_sink.SinkSchemaError, OSError) as e:
+        print(e, file=sys.stderr)
+        return 2
+    report = obs_fleet.fleet_report(summaries)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(obs_fleet.format_fleet(report))
+    return 0
+
+
+def cmd_trend(args) -> int:
+    try:
+        records = load_records(args.file)
+        obs_sink.check_schema(records, source=args.file)
+    except (obs_sink.SinkSchemaError, OSError) as e:
+        print(e, file=sys.stderr)
+        return 2
+    trend = obs_fleet.bench_trend(records, metric=args.metric)
+    regressed = (
+        obs_fleet.trend_regressions(trend, args.fail_on_regression)
+        if args.fail_on_regression is not None
+        else []
+    )
+    if args.json:
+        print(json.dumps({"trend": trend, "regressions": regressed},
+                         sort_keys=True))
+    else:
+        print(obs_fleet.format_trend(trend, regressed))
+    if regressed:
+        print(
+            f"{len(regressed)} config(s) regressed more than "
+            f"{args.fail_on_regression}% vs prior best",
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 
@@ -194,8 +238,32 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("prom", help="Prometheus text exposition")
     p.add_argument("file")
+    p.add_argument(
+        "--timestamp", action="store_true",
+        help="stamp every sample with the record's ts (ms epoch)",
+    )
     common(p)
     p.set_defaults(fn=cmd_prom)
+
+    p = sub.add_parser(
+        "fleet", help="aggregate devices' sink files into one fleet report"
+    )
+    p.add_argument("files", nargs="+", metavar="DEVICE.jsonl")
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "trend", help="per-config perf trajectory over BENCH_LOCAL.jsonl"
+    )
+    p.add_argument("file")
+    p.add_argument("--metric", help="only configs of this metric")
+    p.add_argument(
+        "--fail-on-regression", type=float, metavar="PCT",
+        help="exit 1 when a config's latest run is more than PCT%% below "
+        "its best earlier run",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.set_defaults(fn=cmd_trend)
 
     args = ap.parse_args(argv)
     return args.fn(args)

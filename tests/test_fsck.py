@@ -162,6 +162,27 @@ def test_post_compaction_tail_is_healthy(tmp_path):
     run(go())
 
 
+def test_detects_malformed_snapshot_sealer(tmp_path):
+    """Snapshots may carry a third element — the sealer's 16-byte actor
+    id (replication obs).  A healthy remote's sealed snapshots pass
+    (covered above); a wrong-width sealer is flagged, not ignored."""
+
+    async def go():
+        a, _b = await populate(tmp_path)
+        state_obj = a.with_state(lambda s: a.adapter.state_to_obj(s))
+        bad = await a._seal(  # noqa: SLF001 — white-box wire forgery
+            [state_obj, {}, b"short"]
+        )
+        await a.storage.store_state(bad)
+        report = await checker(tmp_path)
+        assert not report.ok
+        assert any(
+            "sealer id is not 16 bytes" in i.problem for i in report.issues
+        )
+
+    run(go())
+
+
 def test_dangling_latest_key_reported_not_crash(tmp_path):
     """A latest-id register that survives while its key material is lost
     must produce a keys issue, not an unhandled DanglingLatestKey."""

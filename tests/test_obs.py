@@ -380,10 +380,17 @@ def test_sink_jsonl_roundtrip_and_prometheus(tmp_path):
     assert rec["meta"] == {"note": "hi"}
     assert "events" not in rec  # events off → no timeline payload
     prom = sink.to_prometheus(rec)
-    assert 'crdt_counter_total{name="ops_folded"} 7' in prom
+    assert "crdt_ops_folded_total 7" in prom
     assert 'crdt_span_count_total{span="stream.fold"} 1' in prom
-    assert 'crdt_gauge{name="device_bytes_in_use"} 123' in prom
+    assert "crdt_device_bytes_in_use 123" in prom
     assert 'quantile="0.95"' in prom
+    # registry-derived exposition metadata (ISSUE 6 satellite)
+    assert "# TYPE crdt_ops_folded_total counter" in prom
+    assert "# TYPE crdt_device_bytes_in_use gauge" in prom
+    assert "# HELP crdt_ops_folded_total" in prom
+    # sink records are schema-stamped so fleet/trend can reject
+    # mixed-version inputs loudly
+    assert rec["schema"] == sink.SCHEMA_VERSION
 
 
 def test_sink_drains_events_per_write(tmp_path):

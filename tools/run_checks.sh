@@ -19,6 +19,12 @@ python tools/check_span_names.py
 echo "== thread-discipline shim =="
 python tools/check_thread_discipline.py
 
+echo "== obs_report fleet golden =="
+python -m crdt_enc_tpu.tools.obs_report fleet \
+    tests/data/fleet_device_a.jsonl tests/data/fleet_device_b.jsonl \
+    | diff -u tests/data/obs_fleet_golden.txt - \
+    || { echo "fleet rendering drifted from tests/data/obs_fleet_golden.txt"; exit 1; }
+
 echo "== parity count =="
 python - <<'EOF'
 import pathlib
