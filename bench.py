@@ -530,6 +530,328 @@ def _timed_host(fn):
     return time.perf_counter() - t0
 
 
+def _tenants_arg(default: int) -> int:
+    """``--tenants N`` (the multitenant sweep size), else ``default``."""
+    if "--tenants" in sys.argv:
+        i = sys.argv.index("--tenants")
+        if i + 1 < len(sys.argv):
+            try:
+                n = int(sys.argv[i + 1])
+            except ValueError:
+                raise SystemExit(f"--tenants wants N, got {sys.argv[i + 1]!r}")
+            if n > 0:
+                return n
+        raise SystemExit("--tenants wants a positive count")
+    return default
+
+
+def _quantiles_ms(samples_s: list) -> dict:
+    """Exact nearest-rank p50/p99 of a latency sample set, in ms (the
+    obs histograms are ±9% bucketed; the bench records exact values)."""
+    import math
+
+    s = sorted(samples_s)
+
+    def pick(q):
+        return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+    return {
+        "p50_ms": round(pick(0.50) * 1e3, 2),
+        "p99_ms": round(pick(0.99) * 1e3, 2),
+        "max_ms": round(s[-1] * 1e3, 2),
+    }
+
+
+def e2e_multitenant(smoke: bool):
+    """ISSUE-7 acceptance: the multi-tenant fold service
+    (crdt_enc_tpu/serve/) vs sequential per-tenant solo compacts.
+
+    T tenants, each its own encrypted remote (memory backend, XChaCha
+    AEAD, three-layer wire format) populated with a config-3-shaped op
+    stream across a few replica actors.  The remotes are duplicated;
+    one copy is compacted tenant-by-tenant through the normal solo
+    ``Core.compact()`` loop, the other through ONE
+    ``FoldService.run_cycle()`` — ragged-bucketed mega-folds, shared
+    decode fan-out, per-tenant sealed snapshots.  Byte equality of
+    every tenant's state is ASSERTED (the run refuses to record
+    otherwise); the headline is *aggregate* ops/s and the p50/p99
+    per-tenant completion latency (sequential tenants queue behind each
+    other — that IS the serving model being replaced).  A second
+    service cycle over a ~10% op tail measures the warm-tier path
+    (plane reuse across cycles).  Appends the full record + obs
+    snapshot to BENCH_LOCAL.jsonl (CPU records need BENCH_LOCAL_ALL=1,
+    as for the other e2e benches).
+
+    The default shape is the many-SMALL-tenants fleet the serving layer
+    exists for: 384 ops per tenant flushed as 24-op files (16 pending
+    files), where a solo compact's cost is machinery-bound (the
+    pipelined ingest engages at 16 files and costs ~7-8ms/tenant on
+    this box almost independent of op count) — exactly the per-tenant
+    overhead the batch amortizes.  Bigger tenants shift the balance
+    toward shared work (decrypt/decode/fold) that both sides pay;
+    sweep BENCH_MT_OPS/BENCH_MT_OPF to map the landscape.
+
+    Env knobs: BENCH_MT_TENANTS (256; --tenants N overrides),
+    BENCH_MT_OPS (384 per tenant), BENCH_MT_REPLICAS (4 per tenant),
+    BENCH_MT_MEMBERS (64 per tenant), BENCH_MT_OPF (24 ops/file),
+    BENCH_MT_TAIL_PCT (10), BENCH_MT_ITERS (3 — best-of passes per
+    side, each on fresh fleet copies).
+    """
+    import asyncio
+    import copy
+
+    T = _tenants_arg(int(os.environ.get(
+        "BENCH_MT_TENANTS", 16 if smoke else 256)))
+    N = int(os.environ.get("BENCH_MT_OPS", 96 if smoke else 384))
+    R = int(os.environ.get("BENCH_MT_REPLICAS", 4))
+    E = int(os.environ.get("BENCH_MT_MEMBERS", 64))
+    OPF = int(os.environ.get("BENCH_MT_OPF", 24))
+    TAIL_PCT = float(os.environ.get("BENCH_MT_TAIL_PCT", 10.0))
+
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    first_platform = platforms.split(",")[0].strip() if platforms else ""
+    want_tpu = first_platform not in ("cpu",) and not smoke
+    jax, dev = acquire_jax(want_tpu)
+
+    import crdt_enc_tpu
+    from benchmarks.suite import actor_bytes_table
+    from crdt_enc_tpu.backends import (
+        MemoryRemote, MemoryStorage, PlainKeyCryptor, XChaChaCryptor,
+    )
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.models import canonical_bytes
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.serve import FoldService
+    from crdt_enc_tpu.utils import trace
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    crdt_enc_tpu.enable_compilation_cache()
+
+    def opts(storage):
+        return OpenOptions(
+            storage=storage,
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+            accelerator=TpuAccelerator(),
+        )
+
+    actors = actor_bytes_table(R)
+
+    def tenant_files(seed: int):
+        """One tenant's op-file payload stream (config-3-shaped adds +
+        removes over R actors, OPF ops/file, dense versions per actor)."""
+        kind, member, actor, counter = gen_columns(N, R, E, seed=seed)
+        live = actor < R
+        order = np.argsort(actor[live], kind="stable")
+        k_l, m_l = kind[live][order], member[live][order]
+        a_l, c_l = actor[live][order], counter[live][order]
+        i, n = 0, len(k_l)
+        versions: dict = {}
+        out = []
+        while i < n:
+            j = min(i + OPF, n)
+            j = i + int(np.searchsorted(a_l[i:j], a_l[i], side="right"))
+            ab = actors[int(a_l[i])]
+            ops = []
+            for t in range(i, j):
+                if k_l[t] == 0:
+                    ops.append([0, int(m_l[t]), [ab, int(c_l[t])]])
+                else:
+                    ops.append([1, int(m_l[t]), {ab: int(c_l[t])}])
+            v = versions.get(ab, 0) + 1
+            versions[ab] = v
+            out.append((ab, v, ops))
+            i = j
+        return out
+
+    async def build():
+        """Per tenant: a pristine remote of sealed head files, plus the
+        tail PRE-SEALED as raw blobs (so the warm-cycle phase can drop
+        them into any fleet copy's storage)."""
+        remotes, tails, total_ops = [], [], 0
+        for t in range(T):
+            files = tenant_files(seed=100 + t)
+            n_tail = max(1, int(len(files) * TAIL_PCT / 100.0))
+            head, tail = files[:-n_tail], files[-n_tail:]
+            remote = MemoryRemote()
+            writer = await Core.open(opts(MemoryStorage(remote)))
+            for ab, v, ops in head:
+                blob = await writer._seal(ops)
+                await writer.storage.store_ops(ab, v, blob)
+            total_ops += sum(len(ops) for _, _, ops in head)
+            remotes.append(remote)
+            tails.append([
+                (ab, v, await writer._seal(ops), len(ops))
+                for ab, v, ops in tail
+            ])
+        return remotes, tails, total_ops
+
+    remotes, tails, total_ops = asyncio.run(build())
+    log(
+        f"e2e_multitenant: device {dev.platform}; {T} tenants, "
+        f"{total_ops} head ops total, R={R}/tenant E={E}/tenant"
+    )
+
+    ITERS = max(1, int(os.environ.get("BENCH_MT_ITERS", 1 if smoke else 3)))
+
+    async def measure():
+        # ---- warmup: compile exclusion, the repo's standard protocol.
+        # A throwaway copy of the fleet runs one full service cycle (the
+        # mega-fold compiles per size class, T included) and a few solo
+        # compacts (the session fold's buckets) — the measured passes
+        # below are steady-state on both sides.
+        warm_fleet = [
+            await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+            for r in remotes
+        ]
+        await FoldService(warm_fleet).run_cycle()
+        for r in remotes[: min(8, T)]:
+            c = await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+            await c.compact()
+        del warm_fleet
+
+        # ---- best-of-ITERS passes (each on fresh fleet copies, byte
+        # equality asserted on EVERY pair — the e2e-streaming protocol:
+        # wall minima, with the full sample sets recorded)
+        t_seq = t_serve = float("inf")
+        seq_lat = serve_lat = None
+        obs_seq = obs_serve = None
+        equal = True
+        paths: dict = {}
+        service = None
+        for _ in range(ITERS):
+            solo_cores = [
+                await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+                for r in remotes
+            ]
+            served_cores = [
+                await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+                for r in remotes
+            ]
+            # sequential baseline: tenant-by-tenant solo compacts; a
+            # tenant's completion latency includes its queue wait — that
+            # is the one-remote-at-a-time serving model being replaced
+            trace.reset()
+            lat = []
+            t0 = time.perf_counter()
+            for c in solo_cores:
+                await c.compact()
+                lat.append(time.perf_counter() - t0)
+            t = time.perf_counter() - t0
+            if t < t_seq:
+                t_seq, seq_lat, obs_seq = t, lat, trace.snapshot()
+
+            # one service cycle over the whole fleet
+            svc = FoldService(served_cores)
+            trace.reset()
+            t0 = time.perf_counter()
+            results = await svc.run_cycle()
+            t = time.perf_counter() - t0
+            errors = [
+                (i, r.error) for i, r in enumerate(results) if r.error
+            ]
+            assert not errors, f"service tenant errors: {errors[:3]}"
+            equal = equal and all(
+                a.with_state(canonical_bytes)
+                == b.with_state(canonical_bytes)
+                for a, b in zip(solo_cores, served_cores)
+            )
+            if t < t_serve:
+                t_serve = t
+                serve_lat = [r.latency_s for r in results]
+                obs_serve = trace.snapshot()
+                paths = {}
+                for r in results:
+                    paths[r.path] = paths.get(r.path, 0) + 1
+                service = svc
+                warm_fleet_cores = served_cores
+
+        # ---- warm cycle: the TAIL_PCT op tail lands on the best pass's
+        # fleet, the service folds it through the warm plane tier
+        n_tail_ops = 0
+        for core, tail in zip(warm_fleet_cores, tails):
+            for ab, v, blob, n_ops in tail:
+                await core.storage.store_ops(ab, v, blob)
+                n_tail_ops += n_ops
+        trace.reset()
+        t0 = time.perf_counter()
+        results2 = await service.run_cycle()
+        t_warm = time.perf_counter() - t0
+        snap2 = trace.snapshot()
+        warm_hits = snap2["counters"].get("serve_warm_hits", 0)
+        assert all(r.error is None for r in results2)
+
+        return (
+            t_seq, t_serve, seq_lat, serve_lat, equal, paths, obs_seq,
+            obs_serve, t_warm, n_tail_ops, warm_hits,
+        )
+
+    (t_seq, t_serve, seq_lat, serve_lat, equal, paths, obs_seq, obs_serve,
+     t_warm, n_tail_ops, warm_hits) = asyncio.run(measure())
+
+    agg_serve = total_ops / t_serve
+    agg_seq = total_ops / t_seq
+    speedup = t_seq / t_serve
+    log(
+        f"sequential {t_seq:.2f}s ({agg_seq:,.0f} ops/s) vs service "
+        f"{t_serve:.2f}s ({agg_serve:,.0f} ops/s) → {speedup:.2f}x; "
+        f"byte-identical: {equal}; paths: {paths}"
+    )
+    log(
+        f"warm cycle: {n_tail_ops} tail ops in {t_warm:.2f}s "
+        f"({n_tail_ops / t_warm:,.0f} ops/s, warm hits {warm_hits}/{T})"
+    )
+    result = {
+        "metric": "orset_multitenant_agg_ops_per_sec",
+        "config": f"multitenant_{T}t",
+        "value": round(agg_serve, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(speedup, 2),
+        "sequential_agg_ops_per_sec": round(agg_seq, 1),
+        "service_cycle_s": round(t_serve, 4),
+        "sequential_s": round(t_seq, 4),
+        "tenant_latency": _quantiles_ms(serve_lat),
+        "sequential_tenant_latency": _quantiles_ms(seq_lat),
+        "fold_paths": paths,
+        "warm_cycle": {
+            "tail_ops": n_tail_ops,
+            "cycle_s": round(t_warm, 4),
+            "ops_per_sec": round(n_tail_ops / t_warm, 1),
+            "warm_hits": warm_hits,
+        },
+        "byte_identical": bool(equal),
+        "backend": dev.platform,
+    }
+    print(json.dumps(result))
+    if not equal:
+        log("FAILED: per-tenant states diverged — refusing to record")
+        raise SystemExit(1)
+    if os.environ.get("BENCH_LOCAL_DISABLE") == "1":
+        return
+    if dev.platform != "tpu" and os.environ.get("BENCH_LOCAL_ALL") != "1":
+        return
+    _append_local({
+        **result,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "device_kind": dev.device_kind,
+        # with 2 cores the decode fan-out and the consumer share
+        # silicon; the dispatch-amortization win is what remains —
+        # large-tenant-count and TPU numbers await hardware (same
+        # caveat as the PR-1/PR-3 records)
+        "host_cpus": os.cpu_count(),
+        "shape": {"tenants": T, "ops_per_tenant": N, "replicas": R,
+                  "members": E, "ops_per_file": OPF,
+                  "total_ops": total_ops, "iters": ITERS},
+        "obs": obs_serve,
+        "obs_sequential": obs_seq,
+    })
+
+
 def e2e_warm_open(smoke: bool):
     """ISSUE-4 acceptance: cold open vs checkpointed (warm) open of a
     config-5-shaped un-compacted remote with a 1% op tail.
@@ -834,6 +1156,9 @@ def main():
         return
     if "--e2e-warm-open" in sys.argv:
         e2e_warm_open(smoke)
+        return
+    if "--e2e-multitenant" in sys.argv:
+        e2e_multitenant(smoke)
         return
     N = int(os.environ.get("BENCH_OPS", 50_000 if smoke else 1_000_000))
     R = int(os.environ.get("BENCH_REPLICAS", 500 if smoke else 10_000))

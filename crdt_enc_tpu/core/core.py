@@ -289,6 +289,8 @@ class Core:
         # status is kept for callers that want the full dict
         self._repl_sample = os.environ.get("CRDT_REPL_SAMPLE", "") != "0"
         self.last_replication_status: dict | None = None
+        # memoized _remote_id; dropped by every remote-meta merge site
+        self._remote_id_cache: bytes | None = None
 
     # ------------------------------------------------------------------ open
     @classmethod
@@ -478,10 +480,18 @@ class Core:
         """SHA3 of the canonical converged RemoteMeta — the stable
         identity of the remote this replica is attached to.  Doubles as
         the checkpoint fingerprint's meta hash and the ``remote_id`` the
-        replication status / fleet aggregator group devices by."""
-        return hashlib.sha3_256(
-            codec.pack(self._data.remote_meta.to_obj())
-        ).digest()
+        replication status / fleet aggregator group devices by.
+
+        Cached: the hash is read several times per compaction (the
+        checkpoint fingerprint + every replication sample — at fleet
+        scale that is 3+ pack+SHA3 rounds per tenant per service
+        cycle) while the RemoteMeta only changes on a meta merge; every
+        merge site drops the cache."""
+        if self._remote_id_cache is None:
+            self._remote_id_cache = hashlib.sha3_256(
+                codec.pack(self._data.remote_meta.to_obj())
+            ).digest()
+        return self._remote_id_cache
 
     def _pack_checkpoint_state(self):
         """(fmt, obj) for the current state: the packed-columnar ORSet
@@ -499,7 +509,7 @@ class Core:
     def _unpack_checkpoint_state(self, fmt: int, st):
         return unpack_checkpoint_state(self.adapter, fmt, st)
 
-    async def save_checkpoint(self) -> bool:
+    async def save_checkpoint(self, *, _packed: tuple | None = None) -> bool:
         """Seal the materialized state + ingest cursor + read-states set
         as this replica's local warm-open checkpoint (sealed with the
         normal data-key cryptor, stored through the storage port's
@@ -507,7 +517,14 @@ class Core:
         ingests only op tails past the cursor — state-based CRDTs need
         no op log to resume (arXiv:1905.08733), so the persisted state +
         cursor is a complete, safe resume point.  Returns False when
-        checkpointing is disabled on this core."""
+        checkpointing is disabled on this core.
+
+        ``_packed`` is the fold service's pre-packed state payload,
+        ``(fmt, obj, mut_epoch)``: the service packs from the dense
+        planes it already holds (no sparse walk), and the epoch guards
+        staleness — if the state mutated since packing (a concurrent
+        apply), the live state is re-packed here instead, so the sealed
+        (state, cursor) pair can never tear."""
         if not self._checkpoint_enabled:
             return False
         with trace.span("checkpoint.save"):
@@ -515,7 +532,13 @@ class Core:
             # the first await, so a concurrent apply cannot tear the
             # (state, cursor) pair
             d = self._data
-            fmt, st = self._pack_checkpoint_state()
+            if (
+                _packed is not None
+                and _packed[2] == getattr(d.state, "_mut", None)
+            ):
+                fmt, st = _packed[0], _packed[1]
+            else:
+                fmt, st = self._pack_checkpoint_state()
             sig = (
                 dict(d.next_op_versions.counters), frozenset(d.read_states)
             )
@@ -1119,30 +1142,9 @@ class Core:
         outer framing surprises us, so the per-file path can produce its
         precise error; key-auth and op-order violations raise exactly as
         the per-file path would (lib.rs:519-531 semantics preserved)."""
-        try:
-            with trace.span("ops.bulk_unwrap"):
-                key_ids, middles = [], []
-                for _, _, raw in files:
-                    outer = VersionBytes.deserialize(raw).ensure_versions(
-                        SUPPORTED_CONTAINER_VERSIONS
-                    )
-                    kid, middle = codec.unpack(outer.content)
-                    key_ids.append(bytes(kid))
-                    middles.append(bytes(middle))
-        except Exception:
+        groups = self._unwrap_op_files(files, strict=False)
+        if groups is None:
             return False
-        groups: dict[bytes, list[int]] = {}
-        for i, kid in enumerate(key_ids):
-            groups.setdefault(kid, []).append(i)
-        keys = {}
-        for kid in groups:
-            key = self._data.keys.get_key(kid)
-            if key is None:
-                raise MissingKeyError(
-                    f"ops sealed with unknown key {uuid.UUID(bytes=kid)}; "
-                    "key metadata may not have synced yet"
-                )
-            keys[kid] = key
 
         # Single sealing key (the overwhelmingly common case) + a stream-
         # capable accelerator: chunked decrypt with one-chunk lookahead —
@@ -1162,10 +1164,13 @@ class Core:
         streamed_ok = stream is not None
         with trace.span("ops.bulk_decrypt"):
             if stream is not None:
-                (kid, idxs), = groups.items()
-                material = keys[kid].material
+                (key, idxs, mids), = groups
+                material = key.material
                 CH = BULK_STREAM_CHUNK
                 slices = [idxs[i : i + CH] for i in range(0, len(idxs), CH)]
+                mid_slices = [
+                    mids[i : i + CH] for i in range(0, len(mids), CH)
+                ]
 
                 async def decrypt_chunk(si):
                     # per-chunk producer stage, span-tagged with the chunk
@@ -1175,7 +1180,7 @@ class Core:
                     # bench.py --e2e-streaming use)
                     with trace.span("stream.decrypt", meta=si):
                         return await self.cryptor.decrypt_batch(
-                            material, [middles[i] for i in slices[si]]
+                            material, mid_slices[si]
                         )
 
                 nxt = asyncio.create_task(decrypt_chunk(0))
@@ -1216,16 +1221,19 @@ class Core:
                             pass
             else:
                 clears: list = [None] * len(files)
-                for kid, idxs in groups.items():
+                for key, idxs, mids in groups:
                     outs = await self.cryptor.decrypt_batch(
-                        keys[kid].material, [middles[i] for i in idxs]
+                        key.material, mids
                     )
                     for i, clear in zip(idxs, outs):
                         clears[i] = clear
                 p, m = self._validate_chunk(files, clears, overlay)
                 metas.extend(m)
                 payload_chunks.append(p)
-        trace.add("bytes_decrypted", sum(len(m) for m in middles))
+        trace.add(
+            "bytes_decrypted",
+            sum(len(m) for _, _, mids in groups for m in mids),
+        )
 
         payloads = [p for chunk in payload_chunks for p in chunk]
         if not payloads:
@@ -1253,6 +1261,73 @@ class Core:
             trace.add("ops_folded", len(batch))
         return True
 
+    # -------------------------------------------------- serving front end
+    def _unwrap_op_files(self, files: list, *, strict: bool):
+        """Outer-envelope unwrap of loaded op files, grouped by sealing
+        key: ``[(key, idxs, middles)]`` — ONE implementation of the
+        unwrap → group → key-resolve sequence shared by the whole-batch
+        bulk ingest and the serving front end (a wire or error-message
+        change must have one home).  ``strict=False`` returns None on a
+        framing surprise (the bulk path then re-reads per file for the
+        precise error); ``strict=True`` lets it raise.  An unsynced
+        sealing key raises :class:`MissingKeyError` either way."""
+        try:
+            with trace.span("ops.bulk_unwrap"):
+                key_ids, middles = [], []
+                for _, _, raw in files:
+                    outer = VersionBytes.deserialize(raw).ensure_versions(
+                        SUPPORTED_CONTAINER_VERSIONS
+                    )
+                    kid, middle = codec.unpack(outer.content)
+                    key_ids.append(bytes(kid))
+                    middles.append(bytes(middle))
+        except Exception:
+            if strict:
+                raise
+            return None
+        by_kid: dict[bytes, list[int]] = {}
+        for i, kid in enumerate(key_ids):
+            by_kid.setdefault(kid, []).append(i)
+        groups = []
+        for kid, idxs in by_kid.items():
+            key = self._data.keys.get_key(kid)
+            if key is None:
+                raise MissingKeyError(
+                    f"ops sealed with unknown key {uuid.UUID(bytes=kid)}; "
+                    "key metadata may not have synced yet"
+                )
+            groups.append((key, idxs, [middles[i] for i in idxs]))
+        return groups
+
+    async def load_sealed_ops(self):
+        """The multi-tenant serving layer's ingest front end
+        (crdt_enc_tpu/serve/service.py): list + load + outer-unwrap
+        every op file past the local cursor, grouping ciphertexts by
+        sealing key WITHOUT decrypting, validating, folding, or
+        advancing any cursor.  Returns ``(actors, files, groups)``
+        where ``groups`` is ``[(key, idxs, middles)]`` — the fold
+        service executes many tenants' decrypt plans inside one
+        worker-thread hop (``Cryptor.decrypt_batch_fn``) instead of
+        paying a per-tenant ``asyncio.to_thread`` round-trip, then
+        validates through :meth:`_validate_chunk` and advances cursors
+        only after its fold lands — the solo bulk-ingest discipline,
+        factored so the two cannot drift.  No ``bytes_decrypted``
+        counting here: nothing is decrypted yet — the caller counts
+        after its decrypt phase actually succeeds."""
+        with trace.span("ops.list"):
+            actors = await self.storage.list_op_actors()
+        wanted = [
+            (a, self._data.next_op_versions.get(a) + 1) for a in sorted(actors)
+        ]
+        if not wanted:
+            return [], [], []
+        with trace.span("ops.load"):
+            files = await self.storage.load_ops(wanted)
+        trace.add("op_files_loaded", len(files))
+        if not files:
+            return actors, [], []
+        return actors, files, self._unwrap_op_files(files, strict=True)
+
     # --------------------------------------------------------------- compact
     async def compact(self) -> None:
         """Fold everything, snapshot, write-new-then-delete-old
@@ -1265,10 +1340,45 @@ class Core:
         see docs/streaming_pipeline.md for how to read them."""
         with trace.span("compact.ingest"):
             await self.read_remote(_sample=False)
+        await self._compact_seal()
+
+    async def _compact_seal(
+        self, *, _backlog: list | None = None,
+        _packed_state: tuple | None = None,
+        _state_obj: tuple | None = None,
+    ) -> None:
+        """The seal tail of :meth:`compact`: snapshot the CURRENT state +
+        cursor, write-new-then-delete-old, reseal the warm-open
+        checkpoint, sample replication, and append the sink record.
+
+        Factored out so the multi-tenant serving layer
+        (crdt_enc_tpu/serve/) can install a batch-folded state and then
+        run the EXACT solo sealing path — one implementation of the
+        snapshot wire form, the GC ordering, and the checkpoint reseal,
+        so a service-compacted remote can never drift from a solo
+        ``compact()``.  ``_backlog`` is forwarded to the replication
+        sample: the service passes ``[]`` because its own ingest just
+        folded everything its listing found (same contract as
+        ``read_remote``'s post-ingest sample) — a batch of N tenants
+        must not pay N per-actor storage probes per dispatch.
+        ``_packed_state`` forwards to :meth:`save_checkpoint` (the
+        service's planes-packed checkpoint payload); ``_state_obj`` is
+        ``(obj, mut_epoch)`` — a pre-built snapshot state object (the
+        service derives it from the canonical fold writeback instead of
+        re-walking the state), used only when the state's mutation
+        epoch still matches, else the live state is serialized here.
+        The canonical packer re-sorts maps, so an equivalent obj seals
+        byte-identical payloads."""
         # sync snapshot section
         d = self._data
+        if _state_obj is not None and _state_obj[1] == getattr(
+            d.state, "_mut", None
+        ):
+            state_obj = _state_obj[0]
+        else:
+            state_obj = self.adapter.state_to_obj(d.state)
         payload = [
-            self.adapter.state_to_obj(d.state),
+            state_obj,
             d.next_op_versions.to_obj(),
             # sealer id: readers attribute the cursor to this replica in
             # their cursor matrix (StateWrapper's wire note) — old
@@ -1295,14 +1405,14 @@ class Core:
         if self._checkpoint_enabled:
             # the freshly compacted state is the ideal warm-open resume
             # point: everything folded, op logs GC'd to the cursor
-            await self.save_checkpoint()
+            await self.save_checkpoint(_packed=_packed_state)
         # local ops are now folded into the snapshot; reset the producer
         # cursor bookkeeping is unnecessary — versions only grow.
         # replication status AFTER the GC + checkpoint seal (backlog is
         # zero by construction, staleness zero): the post-compaction
         # fixed point is what rides into the sink record below — the
         # per-device line the fleet aggregator reads.
-        status = await self._sample_replication()
+        status = await self._sample_replication(_backlog=_backlog)
         # run-scoped metrics sink (CRDT_OBS_SINK / obs.sink.configure):
         # every compaction appends its phase table + counters, so the
         # streaming pipeline is auditable after the process is gone.
@@ -1343,6 +1453,7 @@ class Core:
                 self._data.remote_meta.merge(
                     RemoteMeta.from_obj(codec.unpack(vb.content))
                 )
+                self._remote_id_cache = None
                 self._data.read_metas.add(name)
             if loaded or force_notify:
                 rm = self._data.remote_meta
@@ -1377,14 +1488,17 @@ class Core:
     async def set_remote_meta_storage(self, reg: MVReg) -> None:
         async with self._meta_lock:
             self._data.remote_meta.storage.merge(reg)
+            self._remote_id_cache = None
             await self._store_remote_meta()
 
     async def set_remote_meta_cryptor(self, reg: MVReg) -> None:
         async with self._meta_lock:
             self._data.remote_meta.cryptor.merge(reg)
+            self._remote_id_cache = None
             await self._store_remote_meta()
 
     async def set_remote_meta_key_cryptor(self, reg: MVReg) -> None:
         async with self._meta_lock:
             self._data.remote_meta.key_cryptor.merge(reg)
+            self._remote_id_cache = None
             await self._store_remote_meta()

@@ -32,6 +32,17 @@ class Cryptor(ABC):
         decrypt front end of streaming compaction, SURVEY.md §7 step 6)."""
         return [await self.decrypt(key, b) for b in blobs]
 
+    def decrypt_batch_fn(self, key: VersionBytes):
+        """Optional SYNC twin of :meth:`decrypt_batch`: a plain callable
+        ``(blobs) -> clears`` bound to ``key``, or None when this cipher
+        has no GIL-releasing sync path.  The multi-tenant fold service
+        uses it to run MANY tenants' decrypts inside ONE worker-thread
+        hop — per-tenant ``asyncio.to_thread`` round-trips (~1ms each on
+        a busy box) otherwise dominate a cycle over thousands of small
+        tenants.  Must be semantically identical to ``decrypt_batch``;
+        backends that override one must keep the other in step."""
+        return None
+
     async def init(self, core) -> None: ...
 
     async def set_remote_meta(self, meta) -> None:

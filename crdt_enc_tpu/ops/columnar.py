@@ -220,6 +220,64 @@ def orset_state_to_planes(
     return clock, add, rm
 
 
+def _grouped_rows_dicts_native(
+    m_idx: np.ndarray, a_idx: np.ndarray, ctr: np.ndarray,
+    members: list, actors: list, target: dict,
+) -> bool:
+    """ONE home for the native ``grouped_rows_dicts`` invocation
+    (statebuild.cpp): member-contiguous int32/int32/int64 rows → nested
+    ``{member: {actor: counter}}`` dicts in one C pass.  Returns False
+    — with ``target`` left EMPTY (a partial fill is cleared) — when the
+    native library is unavailable or declines; callers then run their
+    own Python fallback.  Shared by the checkpoint unpack and the plane
+    writeback, so the ABI and the partial-fill recovery can never
+    drift between them."""
+    try:
+        import ctypes
+
+        from .. import native
+
+        lib = native.load_state()
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        rc = lib.grouped_rows_dicts(
+            np.ascontiguousarray(m_idx, np.int32).ctypes.data_as(i32p),
+            np.ascontiguousarray(a_idx, np.int32).ctypes.data_as(i32p),
+            np.ascontiguousarray(ctr, np.int64).ctypes.data_as(i64p),
+            len(m_idx), members, actors, target,
+        )
+        if rc == 0:
+            return True
+        target.clear()  # partial native fill: rebuild from scratch
+    except Exception as e:
+        _warn_no_native_state(e)
+    return False
+
+
+def _fill_dicts_from_plane(plane: np.ndarray, members: Vocab,
+                           replicas: Vocab, target: dict) -> None:
+    """Nonzero plane cells → nested ``{member: {actor: counter}}`` dicts.
+
+    ``np.nonzero`` yields rows in row-major order, i.e. grouped by
+    member — exactly the contiguous-groups contract of the native
+    ``grouped_rows_dicts`` pass, so the dict assembly that dominated
+    the plane writeback at fleet scale (~0.6ms per small tenant, ×
+    every tenant × every service cycle — and every solo session
+    finish) runs as one C call.  The Python loop remains as the
+    no-native fallback, byte-identical."""
+    es, rs = np.nonzero(plane)
+    if not len(es):
+        return
+    if _grouped_rows_dicts_native(
+        es, rs, plane[es, rs], members.items, replicas.items, target
+    ):
+        return
+    for e, r in zip(es.tolist(), rs.tolist()):
+        target.setdefault(members.items[e], {})[replicas.items[r]] = int(
+            plane[e, r]
+        )
+
+
 def orset_planes_to_state(
     clock: np.ndarray, add: np.ndarray, rm: np.ndarray, members: Vocab, replicas: Vocab
 ) -> ORSet:
@@ -232,16 +290,8 @@ def orset_planes_to_state(
     state.clock = VClock(
         {replicas.items[r]: int(clock[r]) for r in np.nonzero(clock)[0]}
     )
-    es, rs = np.nonzero(add)
-    for e, r in zip(es.tolist(), rs.tolist()):
-        state.entries.setdefault(members.items[e], {})[replicas.items[r]] = int(
-            add[e, r]
-        )
-    es, rs = np.nonzero(rm)
-    for e, r in zip(es.tolist(), rs.tolist()):
-        state.deferred.setdefault(members.items[e], {})[replicas.items[r]] = int(
-            rm[e, r]
-        )
+    _fill_dicts_from_plane(add, members, replicas, state.entries)
+    _fill_dicts_from_plane(rm, members, replicas, state.deferred)
     return state
 
 
@@ -553,25 +603,10 @@ def orset_unpack_checkpoint(obj) -> ORSet:
         # member's rows are contiguous.  Native fast path: one C pass
         # builds all the nested dicts (statebuild.cpp) — the Python
         # grouping below cost ~0.5s of every 1M-dot warm open.
-        try:
-            import ctypes
-
-            from .. import native
-
-            lib = native.load_state()
-            i32p = ctypes.POINTER(ctypes.c_int32)
-            i64p = ctypes.POINTER(ctypes.c_int64)
-            rc = lib.grouped_rows_dicts(
-                m_idx.ctypes.data_as(i32p),
-                a_idx.ctypes.data_as(i32p),
-                ctr.ctypes.data_as(i64p),
-                len(m_idx), members, actors, target,
-            )
-            if rc == 0:
-                return
-            target.clear()  # partial native fill: rebuild from scratch
-        except Exception as e:
-            _warn_no_native_state(e)
+        if _grouped_rows_dicts_native(
+            m_idx, a_idx, ctr, members, actors, target
+        ):
+            return
         a_l = a_idx.tolist()
         c_l = ctr.tolist()
         starts = np.flatnonzero(np.r_[True, np.diff(m_idx) != 0])
@@ -584,6 +619,57 @@ def orset_unpack_checkpoint(obj) -> ORSet:
     build(b"em", b"ea", b"ec", state.entries)
     build(b"dm", b"da", b"dc", state.deferred)
     return state
+
+
+def orset_pack_checkpoint_planes(
+    clock: np.ndarray, add: np.ndarray, rm: np.ndarray,
+    members: Vocab, replicas: Vocab,
+) -> dict:
+    """:func:`orset_pack_checkpoint` computed from dense planes instead
+    of the sparse state — all row buffers fall out of ``np.nonzero``
+    with no per-dot Python (the fold service already HOLDS each
+    tenant's folded planes, and the sparse pack walk was its single
+    biggest seal-phase CPU item at fleet scale).  Same wire keys and
+    invariants as the sparse pack: ``actors[:nc]`` are exactly the
+    clock's actors (aligned with ``cc``), row groups are
+    member-contiguous (the unpack contract — here by ``np.nonzero``'s
+    row-major order), tables list only referenced actors/members.  The
+    encodings differ in table/row ORDER (plane order vs dict walk) —
+    legal, the checkpoint is a local cache and ``orset_unpack_
+    checkpoint`` is order-agnostic beyond group contiguity; equality is
+    pinned semantically in tests.  Planes may be bucket-padded: padded
+    cells are zero, so no index past the vocabularies can appear.
+    Counters are int32 by plane construction, so the sparse pack's
+    int64-overflow decline cannot arise."""
+    clock = np.asarray(clock)
+    add = np.asarray(add)
+    rm = np.asarray(rm)
+    cnz = np.nonzero(clock)[0]
+    es, rs = np.nonzero(add)
+    ds, qs = np.nonzero(rm)
+    used = np.union1d(np.union1d(cnz, rs), qs)
+    a_order = np.concatenate([cnz, np.setdiff1d(used, cnz)])
+    a_perm = np.zeros((int(a_order.max()) + 1) if len(a_order) else 1,
+                      np.int32)
+    a_perm[a_order] = np.arange(len(a_order), dtype=np.int32)
+    em = np.unique(es)
+    m_order = np.concatenate([em, np.setdiff1d(np.unique(ds), em)])
+    m_perm = np.zeros((int(m_order.max()) + 1) if len(m_order) else 1,
+                      np.int32)
+    m_perm[m_order] = np.arange(len(m_order), dtype=np.int32)
+    aobj, mobj = replicas.items, members.items
+    return {
+        b"actors": [aobj[int(i)] for i in a_order],
+        b"members": [mobj[int(i)] for i in m_order],
+        b"nc": len(cnz),
+        b"cc": clock[cnz].astype(np.int64).tobytes(),
+        b"em": m_perm[es].tobytes(),
+        b"ea": a_perm[rs].tobytes(),
+        b"ec": add[es, rs].astype(np.int64).tobytes(),
+        b"dm": m_perm[ds].tobytes(),
+        b"da": a_perm[qs].tobytes(),
+        b"dc": rm[ds, qs].astype(np.int64).tobytes(),
+    }
 
 
 # ---- counters ------------------------------------------------------------

@@ -115,6 +115,31 @@ def pncounter_fold(
     return p, n, value
 
 
+@partial(jax.jit, static_argnames=("num_replicas",))
+def gcounter_fold_tenants(
+    clock0: jax.Array,  # (T, R) int32 — per-tenant clocks
+    actor: jax.Array,  # (T, N) int32  (== num_replicas ⇒ padding row)
+    counter: jax.Array,  # (T, N) int32
+    *,
+    num_replicas: int,
+):
+    """Multi-tenant G-Counter fold: :func:`gcounter_fold` vmapped over a
+    tenant axis — one dispatch folds a whole bucket of small tenants
+    (see ``ops.orset.orset_fold_tenants`` for the serving rationale).
+    Reusing the solo kernel keeps BOTH scatter regimes (the
+    ``SORTED_MIN_ROWS`` sort route included — per-lane rows can reach
+    the serving row cap, where the serialized scatter loses); its value
+    scalar is discarded here (XLA DCEs it) — the per-tenant value is
+    derived host-side from the sparse writeback exactly as the solo
+    path does, so no wide-sum truncation question arises."""
+
+    def one(c, a, ct):
+        clock, _value = gcounter_fold(c, a, ct, num_replicas=num_replicas)
+        return clock
+
+    return jax.vmap(one)(clock0, actor, counter)
+
+
 @jax.jit
 def vclock_merge(a: jax.Array, b: jax.Array) -> jax.Array:
     """Elementwise-max merge of dense vector clocks (same replica vocab)."""

@@ -235,6 +235,45 @@ def orset_apply_batch_planes(
     return clock, add, rm
 
 
+@partial(jax.jit, static_argnames=("num_members", "num_replicas"))
+def orset_fold_tenants(
+    clock0: jax.Array,  # (T, R) int32 — per-tenant state clocks
+    add0: jax.Array,  # (T, E, R) int32 — per-tenant state planes
+    rm0: jax.Array,  # (T, E, R) int32
+    kind: jax.Array,  # (T, N) int8 — per-tenant op rows
+    member: jax.Array,  # (T, N) int32
+    actor: jax.Array,  # (T, N) int32  (== num_replicas ⇒ padding row)
+    counter: jax.Array,  # (T, N) int32
+    *,
+    num_members: int,
+    num_replicas: int,
+):
+    """The multi-tenant mega-fold: :func:`orset_fold` with the tenant
+    batch as one more fold axis (``vmap`` over the leading dim), so a
+    whole bucket of small tenants collapses in ONE device dispatch
+    instead of T dispatch+compile-amortization rounds (ROADMAP item 1,
+    the serving shape — millions of *small* remotes, not one huge one).
+
+    Tenants never interact: every scatter segment id is tenant-local by
+    construction of the vmap, so the result planes are exactly what T
+    independent ``orset_fold`` calls would produce — the serving layer's
+    byte-identity differential (tests/test_serve.py) pins it against the
+    solo ``Core.compact`` path end to end.  Shapes are quantized by the
+    serving layer's bucket planner (crdt_enc_tpu/serve/bucketing.py), so
+    compilation count is bounded by size classes, not tenant mixes.
+    Padding rows use the same ``actor == num_replicas`` sentinel as every
+    other fold; dummy tenant slots are all-sentinel rows over zero
+    planes."""
+
+    def one(c, a, r, k, m, ac, ct):
+        return orset_fold(
+            c, a, r, k, m, ac, ct,
+            num_members=num_members, num_replicas=num_replicas,
+        )
+
+    return jax.vmap(one)(clock0, add0, rm0, kind, member, actor, counter)
+
+
 def merge_rule(clock_a, add_a, rm_a, clock_b, add_b, rm_b, clock_merged):
     """The clock-filter merge on raw arrays (clocks already row-broadcast
     ready, ``clock_merged = max(clock_a, clock_b)`` supplied by the

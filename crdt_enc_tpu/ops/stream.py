@@ -385,10 +385,13 @@ class PipelineError(Exception):
 
 
 def run_ingest_pipeline(
-    spans, ingest_fn, reduce_fn, *, depth: int = 0, producers: int = 1
+    spans, ingest_fn, reduce_fn, *, depth: int = 0, producers: int = 1,
+    thread_prefix: str = "crdt-ingest-producer",
 ):
     """Ordered fan-out pipeline over ``spans`` (any sequence of work
-    items, e.g. encrypted-blob slices).
+    items — encrypted-blob slices for one remote's chunked ingest, or
+    whole tenants for the multi-tenant serving layer's cross-tenant
+    decode fan-out, crdt_enc_tpu/serve/service.py).
 
     ``producers`` worker threads pull span indices from a shared cursor
     and run ``ingest_fn(span, k)`` — decrypt + decode; host work whose
@@ -412,7 +415,9 @@ def run_ingest_pipeline(
 
     Stage timing: each ingest runs under a ``stream.ingest`` span and
     each reduce under ``stream.reduce``, both with ``meta=k``; workers
-    are named ``crdt-ingest-producer-<i>`` so the timeline export gives
+    are named ``<thread_prefix>-<i>`` (default ``crdt-ingest-producer``;
+    the serving layer passes ``crdt-serve-producer`` so its lanes stay
+    distinguishable in a timeline export) so the timeline export gives
     each its own lane.  ``stream.producer.wait`` (meta = producer index)
     times a worker's backpressure stall, ``stream.sequence`` (meta = k)
     times the sequencer's wait for the next in-order chunk, and the
@@ -473,7 +478,7 @@ def run_ingest_pipeline(
     workers = [
         threading.Thread(
             target=produce, args=(i,),
-            name=f"crdt-ingest-producer-{i}", daemon=True,
+            name=f"{thread_prefix}-{i}", daemon=True,
         )
         for i in range(producers)
     ]

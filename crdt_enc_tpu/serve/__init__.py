@@ -1,0 +1,24 @@
+"""Multi-tenant serving: batch many tenants' compactions into shared
+device dispatches (docs/multitenant.md).
+
+* :mod:`.service` — :class:`FoldService`: ingest → cross-tenant decode
+  fan-out → bucketed mega-folds → per-tenant sealed snapshots.
+* :mod:`.bucketing` — pure ragged-shape planner (quantized size
+  classes, spill rules; bounded ``jax_compiles`` across tenant mixes).
+* :mod:`.warm` — tenant-keyed LRU of fold planes under a byte budget.
+"""
+
+from .bucketing import Bucket, TenantShape, plan_buckets
+from .service import FoldService, ServeConfig, TenantResult
+from .warm import PlaneWarmTier, WarmEntry
+
+__all__ = [
+    "Bucket",
+    "FoldService",
+    "PlaneWarmTier",
+    "ServeConfig",
+    "TenantResult",
+    "TenantShape",
+    "WarmEntry",
+    "plan_buckets",
+]

@@ -1,0 +1,123 @@
+"""Ragged tenant bucketing: quantized size classes for the mega-fold.
+
+The fold service batches many tenants' op columns into one device
+dispatch (``ops.orset.orset_fold_tenants``), which needs every tenant in
+a batch to share one padded shape — and the set of *compiled* shapes must
+stay bounded however tenant mixes vary, or the service re-pays XLA
+compilation per mix (the ADVICE-r5 unbounded-recompile bug class, here at
+fleet scale).  This module owns that trade as a pure, unit-testable
+planning function:
+
+* every tenant's ragged ``(rows, members, replicas)`` quantizes to a
+  power-of-two **size class** via the same ``_bucket`` quantizer the
+  accelerator and the fold sessions use (floor 8 — tiny tenants share
+  one class instead of compiling per size 1..8);
+* tenants of one size class and CRDT kind group into **buckets**; a
+  bucket's tenant count pads to a power of two too (floor 1), so the
+  vmapped kernel's leading axis is also drawn from a bounded set;
+* a tenant too big for batching — rows past ``rows_cap`` or dense
+  planes past ``cells_cap`` — **spills to the solo path** (the existing
+  single-tenant accelerator fold, which has sparse/streaming regimes for
+  exactly those shapes); a size-class group larger than ``tenants_cap``
+  splits into several buckets of the same class (bounded stacked-plane
+  memory, zero extra compiles).
+
+The planner never looks at tenant *contents*, only shapes — two shuffled
+mixes of the same size classes produce the same compiled-shape set, which
+``tests/test_serve.py`` pins by asserting ``jax_compiles`` is constant
+across them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# A "small remote" by the survey's production-CRDT sizing; past this the
+# solo accelerator's streaming/sparse regimes are the right machinery.
+DEFAULT_ROWS_CAP = 1 << 15
+# Dense per-tenant plane bound inside a bucket (cells = members·replicas;
+# 1M cells = 4MB/plane/tenant): past it the solo fold's sparse regime
+# (ops/columnar.orset_fold_sparse_host) wins anyway.
+DEFAULT_CELLS_CAP = 1 << 20
+# Tenants per bucket: bounds the stacked planes' host+device footprint
+# without adding compile classes (split buckets share their shape).
+DEFAULT_TENANTS_CAP = 1 << 10
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    """The repo's shape quantizer (same law as parallel/accel.py): the
+    smallest power-of-two ≥ ``n``, floored."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass(frozen=True)
+class TenantShape:
+    """One tenant's ragged fold shape, as measured after decode:
+    ``key`` is the service's tenant handle (opaque to the planner);
+    ``members`` is 0 for plane-less kinds (counters)."""
+
+    key: object
+    kind: str  # "orset" | "gcounter"
+    rows: int
+    members: int
+    replicas: int
+
+
+@dataclass
+class Bucket:
+    """One batched dispatch: ``tenants`` (≤ ``slots``) share the padded
+    shape ``(slots, rows, members, replicas)``; slots beyond the tenant
+    list are dummy all-sentinel lanes over zero planes."""
+
+    kind: str
+    rows: int
+    members: int
+    replicas: int
+    tenants: list
+    slots: int
+
+
+def plan_buckets(
+    shapes: list[TenantShape],
+    *,
+    rows_cap: int = DEFAULT_ROWS_CAP,
+    cells_cap: int = DEFAULT_CELLS_CAP,
+    tenants_cap: int = DEFAULT_TENANTS_CAP,
+) -> tuple[list[Bucket], list]:
+    """Plan one service cycle's batched dispatches.
+
+    Returns ``(buckets, solo)``: the buckets in deterministic
+    (kind, shape) order, and the keys of tenants that spill to the solo
+    path.  Pure — no state, no randomness — so the same shapes always
+    produce the same plan.
+    """
+    if rows_cap < 1 or cells_cap < 1 or tenants_cap < 1:
+        raise ValueError("bucket caps must be positive")
+    groups: dict[tuple, list] = {}
+    solo: list = []
+    for s in shapes:
+        if s.rows <= 0:
+            continue  # nothing to fold — the caller's empty path
+        rows_b = _bucket(s.rows)
+        e_b = _bucket(s.members) if s.kind == "orset" else 0
+        r_b = _bucket(s.replicas)
+        if s.rows > rows_cap or (s.kind == "orset" and e_b * r_b > cells_cap):
+            solo.append(s.key)
+            continue
+        groups.setdefault((s.kind, rows_b, e_b, r_b), []).append(s.key)
+    buckets: list[Bucket] = []
+    for (kind, rows_b, e_b, r_b), keys in sorted(
+        groups.items(), key=lambda kv: (kv[0][0], kv[0][1], kv[0][2], kv[0][3])
+    ):
+        for lo in range(0, len(keys), tenants_cap):
+            chunk = keys[lo : lo + tenants_cap]
+            buckets.append(
+                Bucket(
+                    kind, rows_b, e_b, r_b, chunk,
+                    _bucket(len(chunk), floor=1),
+                )
+            )
+    return buckets, solo

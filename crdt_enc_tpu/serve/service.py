@@ -1,0 +1,774 @@
+"""Multi-tenant fold service: thousands of small remotes, one dispatch.
+
+The paper's design is one device folding one remote; the north star is
+millions of *users* — millions of small encrypted remotes, where every
+solo ``Core.compact()`` pays full dispatch, session, and probe overhead
+per tenant (ROADMAP item 1).  :class:`FoldService` amortizes all of it
+across a fleet of open cores:
+
+1. **ingest** — per tenant, the service reads remote meta + snapshots
+   through the tenant's normal paths and pulls the pending op tail
+   through ``Core.load_sealed_ops`` (list → load → outer unwrap,
+   ciphertexts grouped by sealing key, decrypt deferred to the
+   cycle-wide phase below), then validates versions with the core's
+   own ``_validate_chunk`` — cursors do NOT advance until the fold
+   lands, exactly the solo bulk-ingest discipline.  Tenants ingest
+   concurrently under a bounded semaphore.
+2. **decode** — the PR-3 producer pool (``ops.stream
+   .run_ingest_pipeline``) fans the native columnar decode out ACROSS
+   TENANTS instead of across one tenant's chunks: worker threads decode
+   different tenants' payloads in parallel (the native calls release
+   the GIL) while the sequencer collects results in tenant order.
+3. **plan + fold** — decoded tenants quantize into bucketed size
+   classes (``serve.bucketing``) and every bucket collapses in ONE
+   vmapped device dispatch (``ops.orset.orset_fold_tenants`` /
+   ``ops.counters.gcounter_fold_tenants``): the tenant batch is just
+   another fold axis over the existing columnar kernels.  Oversized
+   tenants spill to the existing solo accelerator paths
+   (``fold_payloads`` — sparse/streaming regimes); tenants the decoder
+   declines fold per-op through ``Core._fold_chunk_python``.  The whole
+   fold phase — plane capture, kernel, writeback, cursor advance — is
+   one synchronous section, so concurrent applies can never interleave
+   a torn (planes, state) pair (the same stall ``finish_session`` buys
+   in the solo pipeline).
+4. **scatter + seal** — per-tenant result planes write back through
+   ``orset_planes_to_state`` into each tenant's live state, and each
+   tenant seals through its normal encrypted snapshot path
+   (``Core._compact_seal``): the same snapshot wire form, GC ordering,
+   checkpoint reseal, and sink record as a solo compact — byte-identical
+   states by construction, pinned end-to-end by the differential tests.
+
+**Warm tier** (``serve.warm``): each tenant's post-fold planes are kept
+under a byte-budgeted LRU keyed by state identity × mutation epoch, so
+the next cycle on an un-mutated tenant skips the sparse state walk and
+the full-plane re-upload — the multi-tenant generalization of the PR-4
+device-resident plane cache.
+
+**Replication probes**: a solo compact pays one per-actor ``stat_ops``
+probe per tenant when it samples replication status.  The service's
+ingest just folded everything its own listing found, so every tenant's
+sample reuses that listing (``_compact_seal(_backlog=[])`` — the same
+contract as ``read_remote``'s post-ingest sample): a batch of N tenants
+pays ZERO extra storage probes per cycle, regression-pinned in
+tests/test_serve.py.
+
+Every phase emits ``serve.*`` spans and the per-tenant end-to-end
+latency lands in the ``serve.tenant`` histogram (p50/p95/p99 via the
+obs registry) — ``bench.py --e2e-multitenant`` publishes them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import ops as K
+from ..models import GCounter, ORSet
+from ..models.counters import POS
+from ..utils import codec, trace
+from . import bucketing
+from .bucketing import TenantShape, _bucket, plan_buckets
+from .warm import DEFAULT_BYTE_BUDGET, PlaneWarmTier
+
+
+@dataclass
+class ServeConfig:
+    """Service knobs; the defaults serve the many-small-tenants shape."""
+
+    rows_cap: int = bucketing.DEFAULT_ROWS_CAP
+    cells_cap: int = bucketing.DEFAULT_CELLS_CAP
+    tenants_cap: int = bucketing.DEFAULT_TENANTS_CAP
+    # decode fan-out width: 0 = auto (ops.stream.stream_producer_count)
+    producers: int = 0
+    # concurrent tenant ingests/seals (bounded asyncio semaphore)
+    io_width: int = 16
+    # warm plane tier (serve.warm); budget in summed plane bytes
+    warm: bool = True
+    warm_bytes: int = DEFAULT_BYTE_BUDGET
+    # seal a snapshot for tenants with no new ops (solo-compact parity);
+    # off = a quiet tenant costs nothing per cycle
+    seal_empty: bool = True
+
+
+@dataclass
+class TenantResult:
+    """One tenant's outcome for one service cycle.  ``path`` is how its
+    ops folded: ``batched`` (the mega-fold), ``solo`` (spilled to the
+    single-tenant accelerator bulk path), ``perop`` (decoder declined —
+    python per-op fold), ``empty`` (no new ops), or ``error``."""
+
+    path: str = "empty"
+    rows: int = 0
+    latency_s: float = 0.0
+    sealed: bool = False
+    error: str | None = None
+
+
+@dataclass
+class _TenantWork:
+    idx: int
+    core: object
+    actors: list = field(default_factory=list)
+    files: list = field(default_factory=list)
+    groups: list = field(default_factory=list)  # (key, idxs, middles)
+    clears: list = field(default_factory=list)
+    payloads: list = field(default_factory=list)
+    metas: list = field(default_factory=list)
+    actors_sorted: list = field(default_factory=list)
+    kind: str | None = None  # "orset" | "gcounter" | None (solo type)
+    cols: tuple | None = None  # decoded columns + vocabs
+    prepared: tuple | None = None  # fold-phase planes/vocabs
+    packed: tuple | None = None  # planes-packed checkpoint payload
+    state_obj: tuple | None = None  # pre-built snapshot state obj
+    result: TenantResult = field(default_factory=TenantResult)
+
+    @property
+    def ok(self) -> bool:
+        return self.result.error is None
+
+
+def _actor_table(state, actors) -> list:
+    """Sorted actor table for the native decoders: the storage listing
+    plus every actor the state mentions (the serving twin of
+    ``TpuAccelerator._orset_actor_table``, without the fast-path
+    micro-optimizations — tenant tables are small by definition)."""
+    actor_set = set(actors)
+    if isinstance(state, ORSet):
+        actor_set.update(state.clock.counters)
+        for entry in state.entries.values():
+            actor_set.update(entry)
+        for dfr in state.deferred.values():
+            actor_set.update(dfr)
+    elif isinstance(state, GCounter):
+        actor_set.update(state.clock.counters)
+    return sorted(actor_set)
+
+
+def _decode_orset_columns(adapter, payloads, actors_sorted):
+    """One tenant's payloads → ``(kind, member, actor, counter, members,
+    replicas)`` columns.  Native span decode first; the Python
+    columnarizer takes over when the native decoder declines OR a
+    member value collision (1 == True, 0.0 == -0.0) makes the native
+    per-bytes vocab unrepresentable as dense planes — the Python path
+    interns by value, which IS the host dict semantics."""
+    from ..ops.native_decode import decode_orset_payload_batch
+
+    try:
+        decoded = decode_orset_payload_batch(payloads, actors_sorted)
+    except RuntimeError:  # native lib unavailable on this box
+        decoded = None
+    if decoded is not None:
+        kind, member_idx, actor_idx, counter, member_objs = decoded
+        members = K.Vocab(member_objs)
+        if len(members) == len(member_objs):
+            replicas = K.Vocab.presorted_unique(list(actors_sorted))
+            return kind, member_idx, actor_idx, counter, members, replicas
+    ops = [
+        adapter.op_from_obj(o) for p in payloads for o in codec.unpack(p)
+    ]
+    members, replicas = K.Vocab(), K.Vocab(list(actors_sorted))
+    cols = K.orset_ops_to_columns(ops, members, replicas)
+    return cols.kind, cols.member, cols.actor, cols.counter, members, replicas
+
+
+def _decode_gcounter_columns(adapter, payloads, actors_sorted):
+    """One tenant's payloads → ``(actor, counter, replicas)`` columns,
+    or None when the rows are not plain G-Counter increments (the
+    per-op path then decides, exactly as the solo bulk path would)."""
+    from ..ops.native_decode import decode_counter_payload_batch
+
+    try:
+        decoded = decode_counter_payload_batch(payloads, actors_sorted)
+    except RuntimeError:  # native lib unavailable on this box
+        decoded = None
+    if decoded is not None:
+        sign, actor_idx, counter = decoded
+        if len(sign) and bool(np.any(sign != POS)):
+            return None
+        return actor_idx, counter, K.Vocab.presorted_unique(
+            list(actors_sorted)
+        )
+    ops = [
+        adapter.op_from_obj(o) for p in payloads for o in codec.unpack(p)
+    ]
+    cols = K.counter_ops_to_columns(ops, K.Vocab(list(actors_sorted)))
+    if len(cols.sign) and bool(np.any(cols.sign != POS)):
+        return None
+    return cols.actor, cols.counter, cols.replicas
+
+
+class FoldService:
+    """Batch many tenants' compactions into shared device dispatches.
+
+    ``tenants`` are OPEN :class:`~crdt_enc_tpu.core.Core` handles, each
+    attached to its own remote; the service takes over their compaction
+    cadence (``run_cycle`` ≈ one ``compact()`` for every tenant).  The
+    service owns the write side of its tenants while a cycle runs the
+    same way a solo compact does — concurrent local ``apply_ops`` are
+    honored (the fold phase is one sync section), but a second
+    concurrent compactor on the same tenant is the caller's bug, as it
+    always was.
+    """
+
+    def __init__(self, tenants, config: ServeConfig | None = None):
+        self.tenants = list(tenants)
+        self.config = config if config is not None else ServeConfig()
+        self.warm = (
+            PlaneWarmTier(self.config.warm_bytes)
+            if self.config.warm
+            else None
+        )
+
+    # ------------------------------------------------------------- cycle
+    async def run_cycle(self) -> list[TenantResult]:
+        """One service cycle: ingest → decode → bucketed mega-folds →
+        per-tenant seal.  Returns one :class:`TenantResult` per tenant
+        (index-aligned with ``self.tenants``).  Tenant failures are
+        isolated: an erroring tenant reports ``path="error"`` and the
+        rest of the fleet still compacts."""
+        t0 = time.perf_counter()
+        works = [_TenantWork(i, core) for i, core in enumerate(self.tenants)]
+        with trace.span("serve.cycle"):
+            await self._ingest_all(works)
+            await self._decrypt_all(works)
+            decodable = [w for w in works if w.ok and w.kind and w.payloads]
+            if decodable:
+                await asyncio.to_thread(self._decode_all, decodable)
+            self._fold_batched(works)
+            await self._fold_fallbacks(works)
+            await self._seal_all(works, t0)
+        trace.add("serve_cycles", 1)
+        trace.add("serve_tenants", len(works))
+        return [w.result for w in works]
+
+    # ------------------------------------------------------------ ingest
+    async def _ingest_all(self, works) -> None:
+        sem = asyncio.Semaphore(max(1, self.config.io_width))
+
+        async def one(w: _TenantWork):
+            async with sem:
+                try:
+                    with trace.span("serve.ingest", meta=w.idx):
+                        core = w.core
+                        await core._read_remote_meta()
+                        await core._read_remote_states()
+                        # decrypt-deferred ops load: ciphertexts grouped
+                        # by sealing key; the cycle-wide decrypt phase
+                        # below opens every tenant's in ONE thread hop
+                        w.actors, w.files, w.groups = (
+                            await core.load_sealed_ops()
+                        )
+                except Exception as e:  # tenant isolation, never fleet-fatal
+                    w.result.error = repr(e)
+                    w.result.path = "error"
+
+        await asyncio.gather(*(one(w) for w in works))
+
+    # ----------------------------------------------------------- decrypt
+    async def _decrypt_all(self, works) -> None:
+        """Open every tenant's ciphertexts, then validate versions.
+
+        Tenants whose cryptor exposes the sync bulk hook
+        (``Cryptor.decrypt_batch_fn``) all decrypt inside ONE
+        ``asyncio.to_thread`` hop — per-tenant thread round-trips
+        (~1ms each) would otherwise dominate a many-small-tenant cycle;
+        the rest fall back to the normal async ``decrypt_batch``.  The
+        version checks (``_validate_chunk``) run back on the event
+        loop: they read live cursors, which must not race a concurrent
+        apply."""
+        sync_plans: list[tuple[_TenantWork, list]] = []
+        async_works: list[_TenantWork] = []
+        for w in works:
+            if not w.ok or not w.files:
+                continue
+            try:
+                plans = []
+                for key, idxs, mids in w.groups:
+                    fn = w.core.cryptor.decrypt_batch_fn(key.material)
+                    if fn is None:
+                        plans = None
+                        break
+                    plans.append((fn, idxs, mids))
+            except Exception as e:  # e.g. foreign key version — tenant-local
+                w.result.error = repr(e)
+                w.result.path = "error"
+                continue
+            if plans is None:
+                async_works.append(w)
+            else:
+                sync_plans.append((w, plans))
+
+        def run_sync_plans():
+            for w, plans in sync_plans:
+                try:
+                    clears: list = [None] * len(w.files)
+                    for fn, idxs, mids in plans:
+                        for i, clear in zip(idxs, fn(mids)):
+                            clears[i] = clear
+                    w.clears = clears
+                    trace.add(
+                        "bytes_decrypted",
+                        sum(len(m) for _, _, mids in plans for m in mids),
+                    )
+                except Exception as e:  # e.g. AeadError — tenant-local
+                    w.result.error = repr(e)
+                    w.result.path = "error"
+
+        if sync_plans:
+            with trace.span("serve.decrypt", meta=len(sync_plans)):
+                await asyncio.to_thread(run_sync_plans)
+        for w in async_works:
+            try:
+                with trace.span("serve.decrypt", meta=w.idx):
+                    clears = [None] * len(w.files)
+                    for key, idxs, mids in w.groups:
+                        outs = await w.core.cryptor.decrypt_batch(
+                            key.material, mids
+                        )
+                        for i, clear in zip(idxs, outs):
+                            clears[i] = clear
+                    w.clears = clears
+                    trace.add(
+                        "bytes_decrypted",
+                        sum(len(m) for _, _, mids in w.groups for m in mids),
+                    )
+            except Exception as e:
+                w.result.error = repr(e)
+                w.result.path = "error"
+        # sync section: inner version checks WITHOUT cursor advance —
+        # cursors move only after the fold lands
+        for w in works:
+            if not w.ok or not w.files:
+                continue
+            try:
+                w.payloads, w.metas = w.core._validate_chunk(
+                    w.files, w.clears
+                )
+                state = w.core._data.state
+                if isinstance(state, ORSet):
+                    w.kind = "orset"
+                elif isinstance(state, GCounter):
+                    w.kind = "gcounter"
+                if w.payloads:
+                    w.actors_sorted = _actor_table(state, w.actors)
+            except Exception as e:
+                w.result.error = repr(e)
+                w.result.path = "error"
+
+    # ------------------------------------------------------------ decode
+    def _decode_all(self, works) -> None:
+        """Cross-tenant decode fan-out: the PR-3 producer pool with
+        TENANTS as the work items.  Runs off the event loop (the native
+        decode calls release the GIL, so the workers genuinely overlap);
+        results land on each work item in tenant order."""
+        from ..ops.stream import run_ingest_pipeline, stream_producer_count
+
+        producers = stream_producer_count(self.config.producers)
+        # a few work items per producer: per-item queue/span overhead is
+        # ~1ms, so thousands of tiny tenants ride in tenant GROUPS
+        group = max(1, -(-len(works) // max(producers * 4, 1)))
+        chunks = [
+            works[i : i + group] for i in range(0, len(works), group)
+        ]
+
+        def decode_one(w: _TenantWork):
+            with trace.span("serve.decode", meta=w.idx):
+                if w.kind == "orset":
+                    return _decode_orset_columns(
+                        w.core.adapter, w.payloads, w.actors_sorted
+                    )
+                return _decode_gcounter_columns(
+                    w.core.adapter, w.payloads, w.actors_sorted
+                )
+
+        def ingest(chunk: list, k: int):
+            out = []
+            for w in chunk:
+                try:
+                    out.append(decode_one(w))
+                except Exception as e:  # tenant isolation
+                    out.append(("error", e))
+            return out
+
+        def reduce(decoded_list, k: int):
+            for w, decoded in zip(chunks[k], decoded_list):
+                if isinstance(decoded, tuple) and len(decoded) == 2 and \
+                        decoded[0] == "error":
+                    w.result.error = repr(decoded[1])
+                    w.result.path = "error"
+                else:
+                    w.cols = decoded  # None = per-op fallback
+
+        run_ingest_pipeline(
+            chunks, ingest, reduce, producers=producers,
+            thread_prefix="crdt-serve-producer",
+        )
+
+    # -------------------------------------------------------------- fold
+    def _fold_batched(self, works) -> None:
+        """Plan and run the bucketed mega-folds.  One synchronous
+        section per cycle: plane capture, kernel dispatch, writeback and
+        cursor advance never interleave with concurrent applies."""
+        by_idx: dict[int, _TenantWork] = {}
+        shapes: list[TenantShape] = []
+        with trace.span("serve.plan"):
+            for w in works:
+                if not (w.ok and w.kind and w.payloads):
+                    continue
+                if w.cols is None:
+                    w.result.path = "perop"
+                    continue
+                if len(w.cols[0]) == 0:
+                    # validated files that decode to ZERO rows (e.g. an
+                    # empty-ctx remove, or an empty op list a foreign
+                    # writer sealed): the fold is a no-op but the
+                    # cursors MUST advance exactly as the solo path's
+                    # — or the sealed snapshot carries a stale cursor
+                    # and the covered files are re-read forever
+                    w.core._advance_cursors(w.metas)
+                    w.result.path = "batched"
+                    continue
+                prepared = self._prepare_tenant(w)
+                if prepared is None:
+                    w.result.path = "solo"
+                    continue
+                shape = prepared[0]
+                w.prepared = prepared[1]
+                by_idx[w.idx] = w
+                shapes.append(shape)
+            buckets, solo = plan_buckets(
+                shapes,
+                rows_cap=self.config.rows_cap,
+                cells_cap=self.config.cells_cap,
+                tenants_cap=self.config.tenants_cap,
+            )
+            for key in solo:
+                by_idx[key].result.path = "solo"
+                trace.add("serve_solo_spills", 1)
+                del by_idx[key]
+        trace.gauge("serve_buckets", len(buckets))
+        for bi, bucket in enumerate(buckets):
+            try:
+                if bucket.kind == "orset":
+                    self._fold_orset_bucket(bi, bucket, by_idx)
+                else:
+                    self._fold_gcounter_bucket(bi, bucket, by_idx)
+            except Exception as e:  # e.g. device OOM stacking a bucket
+                # tenant isolation at bucket granularity: tenants whose
+                # scatter already landed (path "batched", cursors
+                # advanced) go on to seal; the rest of the bucket
+                # reports the error and the OTHER buckets still fold
+                for key in bucket.tenants:
+                    w = by_idx[key]
+                    if w.result.path != "batched":
+                        w.result.error = repr(e)
+                        w.result.path = "error"
+
+    def _prepare_tenant(self, w: _TenantWork):
+        """Fold-phase prep for one decoded tenant: resolve vocabularies
+        (warm-tier remap or state scan) and pin its ragged shape.
+        Returns ``(TenantShape, prepared)`` or None to route the tenant
+        to the solo path (wide clocks the int32 planes cannot hold)."""
+        state = w.core._data.state
+        if w.kind == "orset":
+            from ..parallel.accel import TpuAccelerator
+
+            kind, member, actor, counter, members, replicas = w.cols
+            entry = self.warm.lookup(state) if self.warm is not None else None
+            if entry is not None:
+                remapped = TpuAccelerator._remap_to_cache(
+                    entry, member, actor, members, replicas
+                )
+                if remapped is None:
+                    entry = None
+                else:
+                    member, actor = remapped
+                    members, replicas = entry.members, entry.replicas
+            if entry is None:
+                K.orset_scan_vocab(state, members, replicas)
+            shape = TenantShape(
+                w.idx, "orset", len(kind), len(members), len(replicas)
+            )
+            return shape, (kind, member, actor, counter, members, replicas,
+                           entry)
+        actor_idx, counter, replicas = w.cols
+        clock0 = K.vclock_to_dense(state.clock, replicas)
+        if clock0.dtype != np.int32:
+            return None  # >int32 counters: the solo sparse path's regime
+        shape = TenantShape(
+            w.idx, "gcounter", len(actor_idx), 0, len(replicas)
+        )
+        return shape, (actor_idx, counter, replicas, clock0)
+
+    def _fold_orset_bucket(self, bi: int, bucket, by_idx) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.core import CHECKPOINT_FMT_ORSET
+        from ..parallel.accel import TpuAccelerator
+
+        cpu_backend = jax.default_backend() == "cpu"
+
+        # re-quantize at the call site (idempotent — the planner already
+        # bucketed) so the jitted statics' boundedness is provenance-
+        # checkable (JIT002) right where they are passed
+        N_b = _bucket(bucket.rows)
+        E_b = _bucket(bucket.members)
+        R_b = _bucket(bucket.replicas)
+        T = bucket.slots
+        kind = np.zeros((T, N_b), np.int8)
+        member = np.zeros((T, N_b), np.int32)
+        actor = np.full((T, N_b), R_b, np.int32)  # dummy lanes: all-pad
+        counter = np.zeros((T, N_b), np.int32)
+        clock_rows, add_rows, rm_rows = [], [], []
+        for slot, key in enumerate(bucket.tenants):
+            w = by_idx[key]
+            k, m, a, c, members, replicas, entry = w.prepared
+            n = len(k)
+            kind[slot, :n] = k
+            member[slot, :n] = m
+            actor[slot, :n] = a
+            counter[slot, :n] = c
+            E, R = len(members), len(replicas)
+            if entry is not None:
+                clock0, add0, rm0 = TpuAccelerator._cached_planes_padded(
+                    entry, E_b, R_b
+                )
+            else:
+                clock0, add0, rm0 = K.orset_state_to_planes(
+                    w.core._data.state, members, replicas, scanned=True
+                )
+                pads = ((0, E_b - E), (0, R_b - R))
+                add0 = np.pad(add0, pads)
+                rm0 = np.pad(rm0, pads)
+                clock0 = np.pad(clock0, (0, R_b - R))
+            clock_rows.append(clock0)
+            add_rows.append(add0)
+            rm_rows.append(rm0)
+        for _ in range(T - len(bucket.tenants)):
+            clock_rows.append(jnp.zeros(R_b, jnp.int32))
+            add_rows.append(jnp.zeros((E_b, R_b), jnp.int32))
+            rm_rows.append(jnp.zeros((E_b, R_b), jnp.int32))
+        # every HOST-sourced plane row uploads here (cold scans always;
+        # warm-tier rows too on the CPU backend, where the tier stores
+        # host views) plus the op columns; device-resident rows re-wrap
+        # for free
+        trace.add(
+            "h2d_bytes",
+            sum(
+                x.nbytes
+                for rows in (clock_rows, add_rows, rm_rows)
+                for x in rows
+                if isinstance(x, np.ndarray)
+            )
+            + kind.nbytes + member.nbytes + actor.nbytes + counter.nbytes,
+        )
+        with trace.span("serve.fold", meta=bi):
+            out = K.orset_fold_tenants(
+                jnp.stack(clock_rows), jnp.stack(add_rows),
+                jnp.stack(rm_rows), kind, member, actor, counter,
+                num_members=E_b, num_replicas=R_b,
+            )
+        with trace.span("serve.scatter", meta=bi):
+            clock_all = np.asarray(out[0])
+            add_all = np.asarray(out[1])
+            rm_all = np.asarray(out[2])
+            for slot, key in enumerate(bucket.tenants):
+                w = by_idx[key]
+                _, _, _, _, members, replicas, entry = w.prepared
+                E, R = len(members), len(replicas)
+                state = w.core._data.state
+                folded = K.orset_planes_to_state(
+                    clock_all[slot][:R], add_all[slot][:E, :R],
+                    rm_all[slot][:E, :R], members, replicas,
+                )
+                state.clock = folded.clock
+                state.entries = folded.entries
+                state.deferred = folded.deferred
+                note = getattr(w.core.accel, "_note_orset_writeback", None)
+                if note is not None:
+                    note(state)
+                else:
+                    state._mut += 1
+                w.core._advance_cursors(w.metas)
+                # the warm-open checkpoint payload, packed VECTORIZED
+                # from the planes just written back (the sparse pack
+                # walk was the seal phase's biggest CPU item at fleet
+                # scale); the recorded epoch lets save_checkpoint
+                # reject it if a concurrent apply lands before the seal
+                w.packed = (
+                    CHECKPOINT_FMT_ORSET,
+                    K.orset_pack_checkpoint_planes(
+                        clock_all[slot], add_all[slot], rm_all[slot],
+                        members, replicas,
+                    ),
+                    state._mut,
+                )
+                # snapshot payload obj without a second state walk: the
+                # dicts just written back ARE plane-canonical (entries
+                # non-empty, retired horizons already dropped), so
+                # wrapping them is exactly ORSet.to_obj's output; the
+                # epoch guard keeps the alias safe (any mutation makes
+                # _compact_seal re-serialize the live state) and the
+                # canonical packer re-sorts, so the sealed bytes equal
+                # a solo compact's
+                w.state_obj = (
+                    {
+                        b"c": state.clock.to_obj(),
+                        b"e": state.entries,
+                        b"d": state.deferred,
+                    },
+                    state._mut,
+                )
+                n_rows = len(w.prepared[0])
+                w.result.path = "batched"
+                w.result.rows = n_rows
+                trace.add("serve_rows_folded", n_rows)
+                if self.warm is not None:
+                    # the tenant's next-cycle resume planes, epoch-
+                    # stamped post-writeback.  On an accelerator the
+                    # DEVICE slices are kept (no re-upload next cycle);
+                    # the CPU backend keeps host copies — "device" and
+                    # host are the same silicon there, and small owned
+                    # copies beat pinning the whole bucket stack alive
+                    if cpu_backend:
+                        planes = (
+                            clock_all[slot].copy(),
+                            add_all[slot].copy(),
+                            rm_all[slot].copy(),
+                        )
+                    else:
+                        planes = (out[0][slot], out[1][slot], out[2][slot])
+                    self.warm.store(
+                        state, members, replicas, planes,
+                        canon=entry.canon if entry is not None else None,
+                    )
+
+    def _fold_gcounter_bucket(self, bi: int, bucket, by_idx) -> None:
+        N_b = _bucket(bucket.rows)
+        R_b = _bucket(bucket.replicas)
+        T = bucket.slots
+        actor = np.full((T, N_b), R_b, np.int32)
+        counter = np.zeros((T, N_b), np.int32)
+        clock0 = np.zeros((T, R_b), np.int32)
+        for slot, key in enumerate(bucket.tenants):
+            w = by_idx[key]
+            a, c, replicas, dense = w.prepared
+            n = len(a)
+            actor[slot, :n] = a
+            counter[slot, :n] = c
+            clock0[slot, : len(dense)] = dense
+        trace.add(
+            "h2d_bytes", clock0.nbytes + actor.nbytes + counter.nbytes
+        )
+        with trace.span("serve.fold", meta=bi):
+            out = K.gcounter_fold_tenants(
+                clock0, actor, counter, num_replicas=R_b
+            )
+        with trace.span("serve.scatter", meta=bi):
+            out_all = np.asarray(out)
+            for slot, key in enumerate(bucket.tenants):
+                w = by_idx[key]
+                a, _, replicas, _ = w.prepared
+                state = w.core._data.state
+                state.clock = K.dense_to_vclock(
+                    out_all[slot][: len(replicas)], replicas
+                )
+                w.core._advance_cursors(w.metas)
+                w.result.path = "batched"
+                w.result.rows = len(a)
+                trace.add("serve_rows_folded", len(a))
+
+    @staticmethod
+    def _fallback_rows(w: _TenantWork) -> int:
+        """Op-ROW count for a fallback tenant, same units as the batched
+        path's ``rows``: the decoded columns when the tenant was decoded
+        (solo spills), else a payload unpack count (rare paths only —
+        decoder declines and non-columnar types)."""
+        if w.cols is not None:
+            return len(w.cols[0])
+        return sum(len(codec.unpack(p)) for p in w.payloads)
+
+    # -------------------------------------------------------- fallbacks
+    async def _fold_fallbacks(self, works) -> None:
+        """Tenants outside the mega-fold: solo spills run the existing
+        single-tenant bulk accelerator path on the already-decrypted
+        payloads; decoder-declined tenants fold per-op — both the exact
+        machinery a solo compact would have used."""
+        for w in works:
+            if not w.ok or not w.payloads:
+                continue
+            core = w.core
+            try:
+                if w.result.path == "solo":
+                    ok = core.accel.fold_payloads(
+                        core._data.state, list(w.payloads),
+                        actors_hint=w.actors_sorted,
+                    )
+                    if ok:
+                        core._advance_cursors(w.metas)
+                    else:
+                        # the spilled tenant's bulk path declined too:
+                        # report the machinery that actually folded it
+                        await core._fold_chunk_python(w.files, w.clears)
+                        w.result.path = "perop"
+                        trace.add("serve_python_fallbacks", 1)
+                    w.result.rows = self._fallback_rows(w)
+                elif w.kind is None or w.result.path == "perop":
+                    # no columnar kind (solo type) or decoder declined
+                    ok = core.accel.fold_payloads(
+                        core._data.state, list(w.payloads),
+                        actors_hint=w.actors_sorted,
+                    ) if w.kind is None else False
+                    if ok:
+                        core._advance_cursors(w.metas)
+                        w.result.path = "solo"
+                    else:
+                        await core._fold_chunk_python(w.files, w.clears)
+                        w.result.path = "perop"
+                        trace.add("serve_python_fallbacks", 1)
+                    w.result.rows = self._fallback_rows(w)
+            except Exception as e:
+                w.result.error = repr(e)
+                w.result.path = "error"
+
+    # -------------------------------------------------------------- seal
+    async def _seal_all(self, works, t0: float) -> None:
+        sem = asyncio.Semaphore(max(1, self.config.io_width))
+
+        async def one(w: _TenantWork):
+            async with sem:
+                if not w.ok:
+                    trace.add("serve_tenant_errors", 1)
+                    w.result.latency_s = time.perf_counter() - t0
+                    return
+                if w.result.path == "empty" and not self.config.seal_empty:
+                    w.result.latency_s = time.perf_counter() - t0
+                    return
+                try:
+                    with trace.span("serve.seal", meta=w.idx):
+                        # _backlog=[]: the cycle's ingest folded
+                        # everything its own listing found — no second
+                        # per-actor storage probe per tenant (the PR-6
+                        # probe-cost fix, regression-pinned)
+                        await w.core._compact_seal(
+                            _backlog=[], _packed_state=w.packed,
+                            _state_obj=w.state_obj,
+                        )
+                    w.result.sealed = True
+                except Exception as e:
+                    w.result.error = repr(e)
+                    w.result.path = "error"
+                    trace.add("serve_tenant_errors", 1)
+                dt = time.perf_counter() - t0
+                w.result.latency_s = dt
+                if w.result.sealed:
+                    # the registry documents this histogram as seal
+                    # COMPLETIONS — failed seals carry their latency on
+                    # the TenantResult but stay out of the percentiles
+                    trace.observe("serve.tenant", dt)
+
+        await asyncio.gather(*(one(w) for w in works))

@@ -46,6 +46,30 @@ def test_smoke_emits_one_json_line():
     assert rec["method"] in ("marginal_chain", "single_dispatch_upper_bound")
 
 
+def test_multitenant_smoke_emits_one_json_line():
+    """The ISSUE-7 bench end-to-end on a tiny CPU fleet: one JSON line,
+    byte-identity asserted inside the run (a divergence exits 1)."""
+    r = subprocess.run(
+        [sys.executable, _BENCH, "--e2e-multitenant", "--smoke",
+         "--tenants", "4"],
+        env=_env(
+            JAX_PLATFORMS="cpu", BENCH_LOCAL_DISABLE="1",
+            BENCH_MT_OPS="48", BENCH_MT_OPF="12", BENCH_MT_MEMBERS="16",
+        ),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "orset_multitenant_agg_ops_per_sec"
+    assert rec["value"] > 0
+    assert rec["byte_identical"] is True
+    assert rec["unit"] == "ops/s" and rec["vs_baseline"] > 0
+    assert rec["fold_paths"].get("batched") == 4
+    assert rec["warm_cycle"]["warm_hits"] == 4
+
+
 def test_unavailable_backend_emits_diagnostic_and_exit_3():
     # non-smoke + no TPU: the subprocess probe sees a CPU-only backend,
     # retries are configured to a single fast attempt, and the bench
