@@ -1149,8 +1149,109 @@ async def _plane_reuse_rounds():
     }
 
 
+def _flag_int(flag: str, default: int) -> int:
+    """``--flag N`` from argv, else ``default`` (the --tenants pattern,
+    shared by the sim sweep's --replicas/--steps)."""
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 < len(sys.argv):
+            try:
+                n = int(sys.argv[i + 1])
+            except ValueError:
+                raise SystemExit(f"{flag} wants N, got {sys.argv[i + 1]!r}")
+            if n > 0:
+                return n
+        raise SystemExit(f"{flag} wants a positive count")
+    return default
+
+
+def bench_sim(smoke: bool):
+    """Adversarial-simulator throughput (docs/simulation.md): schedules
+    per second over seeded all-fault runs — the explorable-schedule
+    depth per CI minute, tracked like any other perf surface.  The run
+    refuses to record if ANY schedule violates an invariant (a broken
+    protocol has no meaningful throughput).  Protocol-level simulation
+    is CPU-bound by design, so records land in BENCH_LOCAL.jsonl
+    without the TPU gate.
+
+    Flags/envs: ``--replicas N`` (8), ``--steps M`` (250), ``--faults
+    all|none|cls,cls`` (all), BENCH_SIM_SEEDS (4)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import logging
+
+    logging.disable(logging.WARNING)  # quarantine warns are the point
+    from crdt_enc_tpu.sim import generate, run_schedule
+    from crdt_enc_tpu.tools.sim import _build_faults
+
+    replicas = _flag_int("--replicas", 4 if smoke else 8)
+    steps = _flag_int("--steps", 50 if smoke else 250)
+    spec = "all"
+    if "--faults" in sys.argv:
+        i = sys.argv.index("--faults")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--faults wants all|none|class,class")
+        spec = sys.argv[i + 1]
+    faults = _build_faults(spec)
+    n_seeds = int(os.environ.get("BENCH_SIM_SEEDS", 2 if smoke else 4))
+
+    from collections import Counter
+
+    totals: Counter = Counter()
+    total_steps = total_checks = quarantined = 0
+    t0 = time.perf_counter()
+    for seed in range(n_seeds):
+        schedule = generate(seed, replicas, steps, faults)
+        result = run_schedule(schedule)
+        if not result.ok:
+            raise SystemExit(
+                f"sim seed {seed} violated an invariant: {result.violation}"
+                " — fix the bug (and commit the shrunk fixture); a broken"
+                " protocol has no throughput to record"
+            )
+        totals.update(result.fault_stats)
+        total_steps += result.steps_run
+        total_checks += result.checks_run
+        quarantined += result.quarantined
+    wall = time.perf_counter() - t0
+    result_rec = {
+        "metric": "sim_schedules_per_sec",
+        "config": f"sim_{replicas}r_{steps}s_{spec}",
+        "value": round(n_seeds / wall, 3),
+        "unit": "schedules/s",
+        "steps_per_sec": round(total_steps / wall, 1),
+        "schedules": n_seeds,
+        "replicas": replicas,
+        "steps": steps,
+        "faults": spec,
+        "faults_survived": dict(sorted(totals.items())),
+        "faults_survived_total": sum(totals.values()),
+        "ingest_quarantined": quarantined,
+        "quiescence_checks": total_checks,
+        "violations": 0,
+        "wall_s": round(wall, 3),
+        "backend": "cpu",
+    }
+    log(
+        f"sim: {n_seeds} schedules ({replicas} replicas x {steps} steps, "
+        f"faults={spec}) in {wall:.2f}s = {result_rec['value']} sched/s, "
+        f"{result_rec['faults_survived_total']} faults survived"
+    )
+    print(json.dumps(result_rec))
+    if os.environ.get("BENCH_LOCAL_DISABLE") == "1":
+        return
+    _append_local({
+        **result_rec,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "host_cpus": os.cpu_count(),
+    })
+
+
 def main():
     smoke = "--smoke" in sys.argv
+    if "--sim" in sys.argv:
+        bench_sim(smoke)
+        return
     if "--e2e-streaming" in sys.argv:
         e2e_streaming(smoke)
         return

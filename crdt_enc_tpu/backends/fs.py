@@ -67,23 +67,67 @@ def _write_file_atomic(path: str, data: bytes) -> None:
     _fsync_dir(d)
 
 
-def _write_file_new(path: str, data: bytes) -> None:
+def _write_file_new(
+    path: str, data: bytes, *, relink_vanished_collider: bool = True
+) -> None:
     """Immutable publish: tmp + fsync, then ``os.link`` — which fails with
     EEXIST atomically, unlike an exists-check + rename (TOCTOU) or rename
     itself (silent clobber).  An existing file with identical content is an
-    idempotent content-addressed replay; different content is an error."""
+    idempotent content-addressed replay; different content is an error.
+
+    Concurrent-GC tolerance: another replica's compactor may remove the
+    colliding file — or the whole emptied directory (``remove_ops``
+    rmdir's an emptied actor dir) — between any two steps here.  A
+    vanished DIRECTORY always retries (``makedirs`` recreates it; the
+    name was never observable with other content).  A vanished
+    COLLIDER retries only for content-addressed names
+    (``relink_vanished_collider=True``: same name ⇒ same bytes, so the
+    relink republishes identical content).  Version-addressed op files
+    pass False: the collider existed moments ago, so a peer may have
+    folded it into a snapshot — republishing DIFFERENT content at that
+    version would be invisible to every cursor already past it, a
+    silent write loss; the burned version surfaces as
+    ``FileExistsError`` and the producer's probe loop picks the next
+    one.  The retry is bounded — each round needs a fresh removal, and
+    removals need fresh content to collect."""
     d = os.path.dirname(path)
-    tmp = _write_tmp(d, data)
-    try:
-        os.link(tmp, path)
-    except FileExistsError:
-        with open(path, "rb") as f:
-            if f.read() == data:
-                return
-        raise FileExistsError(f"{path} exists with different content") from None
-    finally:
-        _remove_quiet(tmp)
-    _fsync_dir(d)
+    for _ in range(8):
+        try:
+            tmp = _write_tmp(d, data)
+        except FileNotFoundError:
+            continue  # dir rmdir'd between makedirs and the tmp open
+        try:
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                try:
+                    with open(path, "rb") as f:
+                        if f.read() == data:
+                            return
+                except FileNotFoundError:
+                    if relink_vanished_collider:
+                        continue  # content-addressed: relink same bytes
+                    raise FileExistsError(
+                        f"{path}: version burned by a GC'd concurrent "
+                        "write; probe forward"
+                    ) from None
+                raise FileExistsError(
+                    f"{path} exists with different content"
+                ) from None
+            except FileNotFoundError:
+                continue  # dir rmdir'd between the tmp write and link
+        finally:
+            _remove_quiet(tmp)
+        try:
+            _fsync_dir(d)
+        except FileNotFoundError:
+            # the directory — and with it our freshly linked file — was
+            # emptied and rmdir'd by a concurrent compactor after the
+            # link: the write happened and was legitimately collected,
+            # exactly the observable world of write-then-GC.
+            pass
+        return
+    raise OSError(f"could not publish {path}: directory kept vanishing")
 
 
 def _read_file(path: str) -> bytes | None:
@@ -578,8 +622,17 @@ class FsStorage(Storage):
         return [item for chunk in per_actor for item in chunk]
 
     async def store_ops(self, actor: Actor, version: int, data: bytes) -> None:
+        import functools
+
         path = os.path.join(self._ops_dir(actor), str(version))
-        await self._run(_write_file_new, path, bytes(data))
+        # version-addressed: a vanished collider BURNS the version (the
+        # caller probes forward) — see _write_file_new's contract
+        await self._run(
+            functools.partial(
+                _write_file_new, path, bytes(data),
+                relink_vanished_collider=False,
+            )
+        )
 
     async def remove_ops(self, actor_last_versions: list[tuple[Actor, int]]) -> None:
         def rm(actor: Actor, last: int) -> None:

@@ -71,6 +71,46 @@ class OpOrderError(CoreError):
     layer violated the gap-free ordering contract (lib.rs:527-531)."""
 
 
+class IngestDecryptError(CoreError):
+    """EVERY blob of a multi-file ingest batch failed to open — that is
+    indistinguishable from a dead cryptor backend or damaged key
+    material, so instead of quarantining the whole backlog (a replica
+    that silently stops converging behind warnings), the read aborts
+    loudly with the last underlying error as ``__cause__``.  Nothing
+    was ingested and no cursor moved: retry after the repair.  A
+    single damaged file still quarantines — per-file damage is exactly
+    what the quarantine path exists for."""
+
+
+class StaleWriterError(CoreError):
+    """A reopened producer could not re-learn its own durable history
+    (its op files — or a snapshot covering them — have not synced back),
+    so writing now would mint event identifiers (Orswot dots) already
+    used by pre-crash events.  Two different events with one identity is
+    the one thing a CRDT cannot reconcile: replicas diverge permanently
+    (simulator-discovered; shrunk repro
+    ``tests/data/sim/dot_reuse_crash_reopen.json``).  Retry once the
+    remote has synced."""
+
+
+class _Quarantined:
+    """Sentinel standing in a clears/payloads list for a synced file
+    whose decrypt or decode failed: the file is SKIPPED (quarantined),
+    never folded, and — critically — the ingest cursor is NOT advanced
+    past it, so a later repaired sync retries it.  One damaged file
+    must not abort a whole read (the passively synced directory tears
+    files routinely); an op quarantine also ends its actor's dense run
+    for this pass (nothing past the hole may fold).  Unknown sealing
+    keys stay LOUD (:class:`MissingKeyError`) — that is a sync-state
+    error the caller must see, not file damage."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<quarantined>"
+
+
+_QUARANTINED = _Quarantined()
+
+
 @dataclass
 class LocalMeta:
     """Private per-replica identity + durable producer cursor.
@@ -291,6 +331,9 @@ class Core:
         self.last_replication_status: dict | None = None
         # memoized _remote_id; dropped by every remote-meta merge site
         self._remote_id_cache: bytes | None = None
+        # writer-side dot-reuse guard (_ensure_own_history): the first
+        # write of this incarnation probes for un-refolded own history
+        self._own_history_checked = False
 
     # ------------------------------------------------------------------ open
     @classmethod
@@ -680,7 +723,111 @@ class Core:
             self._data.keys, self.cryptor, raw, self.supported_data_versions
         )
 
+    def _note_quarantine(self, family: str, ident: str, exc: Exception) -> None:
+        """Bookkeeping for one quarantined synced file (see
+        :class:`_Quarantined`): counted under ``ingest_quarantined``
+        and one warning naming the damaged object — the signal an
+        operator greps for before reaching for ``tools/fsck``."""
+        trace.add("ingest_quarantined", 1)
+        logger.warning(
+            "quarantining %s %s: %r (cursor held; retried on repaired sync)",
+            family, ident, exc,
+        )
+
+    async def _decrypt_tolerant(self, key: Key, files: list, middles: list) -> list:
+        """Batched AEAD open with per-file quarantine: the batch fast
+        path first, and on failure a per-file pass that replaces each
+        undecryptable blob with the :class:`_Quarantined` sentinel
+        instead of aborting the whole ingest.
+
+        Escalation rule: when EVERY file of a multi-file batch fails,
+        the failure is indistinguishable from a dead cryptor or damaged
+        key material — quarantining it all would silently stop
+        convergence behind warnings — so :class:`IngestDecryptError`
+        propagates loudly instead (nothing consumed, cursors held).  A
+        single-file batch still quarantines (one torn file IS the
+        per-file damage case this exists for)."""
+        try:
+            return await self.cryptor.decrypt_batch(key.material, middles)
+        except Exception:
+            logger.debug(
+                "batch decrypt failed; isolating per file", exc_info=True
+            )
+        outs, failed = [], []
+        for (actor, version, _), middle in zip(files, middles):
+            try:
+                outs.append(await self.cryptor.decrypt(key.material, middle))
+            except Exception as e:
+                outs.append(_QUARANTINED)
+                failed.append((actor, version, e))
+        if len(files) > 1 and len(failed) == len(files):
+            raise IngestDecryptError(
+                f"all {len(files)} op files in the batch failed to open"
+            ) from failed[-1][2]
+        for actor, version, e in failed:
+            self._note_quarantine("op", f"{actor.hex()}:v{version}", e)
+        return outs
+
     # ------------------------------------------------------------- apply_ops
+    async def _ensure_own_history(self) -> None:
+        """Dot-reuse guard, run under the writer lock before any op is
+        BUILT: a producer whose in-memory clock trails its own durable
+        history would mint event identifiers (Orswot dots) that its
+        pre-crash incarnation already spent on *different* events —
+        after which replicas diverge permanently, because a CRDT merge
+        has no way to tell two events with one identity apart
+        (simulator-discovered: a 4-step no-fault schedule
+        ``add → crash → reopen → add`` reproduces it;
+        ``tests/data/sim/dot_reuse_crash_reopen.json``).
+
+        Cheap when in sync: two integer reads per write, plus ONE
+        own-tail storage probe on the first write of each incarnation
+        (a crash between ``store_ops`` and the local-meta update leaves
+        an op file the durable cursor does not know about — only
+        storage can reveal it).  When behind, the remote is re-read
+        (own op tail, or the snapshot a peer compacted it into); a
+        remote that STILL does not show the recorded history refuses
+        the write loudly (:class:`StaleWriterError`) rather than
+        corrupting every replica quietly."""
+        actor = self.actor_id
+        assert self._local_meta is not None
+        behind = (
+            self._data.next_op_versions.get(actor)
+            < self._local_meta.last_op_version
+        )
+        probe_ok = True
+        if not behind and not self._own_history_checked:
+            try:
+                tail = await self.storage.stat_ops(
+                    [(actor, self._data.next_op_versions.get(actor) + 1)]
+                )
+            except Exception:
+                # a safety guard must not fail OPEN permanently: the
+                # recorded-cursor check above still fails closed, and
+                # leaving the checked flag unset re-probes for the
+                # unrecorded-orphan corner on the next write
+                logger.warning(
+                    "own-tail probe failed; re-probing on the next write",
+                    exc_info=True,
+                )
+                tail = []
+                probe_ok = False
+            behind = bool(tail)
+        if behind:
+            await self.read_remote(_sample=False)
+            if (
+                self._data.next_op_versions.get(actor)
+                < self._local_meta.last_op_version
+            ):
+                raise StaleWriterError(
+                    "own durable history (op files through "
+                    f"v{self._local_meta.last_op_version}) is not yet "
+                    "visible on the remote; writing now would reuse "
+                    "pre-crash event ids"
+                )
+        if probe_ok:
+            self._own_history_checked = True
+
     async def apply_ops(self, ops: list) -> None:
         """Persist a batch of local ops as one immutable op file, then fold
         it into memory (producer path, lib.rs:666-722).
@@ -691,6 +838,7 @@ class Core:
         if not ops:
             return
         async with self._apply_lock:
+            await self._ensure_own_history()
             await self._apply_ops_locked(ops)
 
     async def update(self, build) -> list:
@@ -699,6 +847,7 @@ class Core:
         live state; they are persisted and folded atomically with respect to
         other writers.  Returns the ops."""
         async with self._apply_lock:
+            await self._ensure_own_history()
             ops = LockBox(self._data.state).with_(build)
             if ops is None:
                 return []
@@ -775,19 +924,47 @@ class Core:
             loaded = await self.storage.load_states(new)
         sem = asyncio.Semaphore(IO_CONCURRENCY)
 
+        state_failures: list[tuple[str, Exception]] = []
+
         async def decode(name: str, raw: bytes):
             async with sem:
-                obj = await self._open_sealed(raw)
-                # [state, cursor] or [state, cursor, sealer] — see
-                # StateWrapper's wire note; a malformed sealer id is
-                # ignored (observational), never a read failure
-                sealer = snapshot_sealer(obj)
-                return name, sealer, StateWrapper(
-                    self.adapter.state_from_obj(obj[0]), VClock.from_obj(obj[1])
-                )
+                try:
+                    obj = await self._open_sealed(raw)
+                    # [state, cursor] or [state, cursor, sealer] — see
+                    # StateWrapper's wire note; a malformed sealer id is
+                    # ignored (observational), never a read failure
+                    sealer = snapshot_sealer(obj)
+                    return name, sealer, StateWrapper(
+                        self.adapter.state_from_obj(obj[0]),
+                        VClock.from_obj(obj[1]),
+                    )
+                except MissingKeyError:
+                    raise  # key metadata not synced: loud, not damage
+                except Exception as e:
+                    # torn/tampered snapshot: quarantine it — the name
+                    # stays OUT of read_states, so a repaired sync is
+                    # retried on the next listing
+                    state_failures.append((name, e))
+                    return None
 
         with trace.span("states.decrypt_decode"):
-            decoded = await asyncio.gather(*(decode(n, raw) for n, raw in loaded))
+            decoded = [
+                d
+                for d in await asyncio.gather(
+                    *(decode(n, raw) for n, raw in loaded)
+                )
+                if d is not None
+            ]
+        if len(loaded) > 1 and len(state_failures) == len(loaded):
+            # every snapshot failing = dead cryptor / damaged keys, not
+            # file damage: escalate (the _decrypt_tolerant rule)
+            raise IngestDecryptError(
+                f"all {len(loaded)} state snapshots failed to open"
+            ) from state_failures[-1][1]
+        for name, e in state_failures:
+            self._note_quarantine("state", name, e)
+        if not decoded:
+            return
         # sync section: CvRDT merge (HOT LOOP #1 → accelerator)
         wrappers = [sw for _, _, sw in decoded]
         with trace.span("states.merge"):
@@ -833,9 +1010,17 @@ class Core:
                 return
         sem = asyncio.Semaphore(IO_CONCURRENCY)
 
+        failures: list[tuple[Actor, int, Exception]] = []
+
         async def decode(actor: Actor, version: int, raw: bytes):
             async with sem:
-                return actor, version, await self._open_sealed(raw)
+                try:
+                    return actor, version, await self._open_sealed(raw)
+                except MissingKeyError:
+                    raise  # key metadata not synced: loud, not damage
+                except Exception as e:
+                    failures.append((actor, version, e))
+                    return actor, version, _QUARANTINED
 
         # concurrent decode, ORDER PRESERVED (the reference's `buffered`
         # not `buffer_unordered` — ordering is load-bearing, lib.rs:497-514)
@@ -843,13 +1028,28 @@ class Core:
             decoded = await asyncio.gather(
                 *(decode(a, v, raw) for a, v, raw in files)
             )
+        if len(files) > 1 and len(failures) == len(files):
+            # the _decrypt_tolerant escalation rule, per-file-path twin
+            raise IngestDecryptError(
+                f"all {len(files)} op files failed to open"
+            ) from failures[-1][2]
+        for actor, version, e in failures:
+            self._note_quarantine("op", f"{actor.hex()}:v{version}", e)
 
         # sync section: version bookkeeping + batched fold (HOT LOOP #2)
         batch = []
+        blocked: set[Actor] = set()  # actors cut at a quarantined file
         for actor, version, payload in decoded:
+            if actor in blocked:
+                continue
             expected = self._data.next_op_versions.get(actor) + 1
             if version < expected:
                 continue  # concurrent-read tolerance (lib.rs:521-525)
+            if payload is _QUARANTINED:
+                # the hole ends this actor's dense run for this pass;
+                # the cursor stays put so the file is retried later
+                blocked.add(actor)
+                continue
             if version > expected:
                 raise OpOrderError(
                     f"op file v{version} for {uuid.UUID(bytes=actor)} arrived "
@@ -863,31 +1063,49 @@ class Core:
             trace.add("ops_folded", len(batch))
 
     # ------------------------------------------------- pipelined bulk ingest
-    def _validate_chunk(self, files: list, clears: list, overlay=None):
+    def _validate_chunk(self, files: list, clears: list, overlay=None,
+                        blocked: set | None = None):
         """Sync section: ordered version bookkeeping for one chunk WITHOUT
         advancing the global cursors (the caller advances only after the
         chunk's fold is accepted — a declined or failed chunk stays
         re-readable).  ``overlay`` carries validated-but-not-yet-advanced
-        versions across chunks when several are in flight.  Returns
+        versions across chunks when several are in flight; ``blocked``
+        likewise carries quarantine cuts (an actor whose run hit a
+        damaged file — see :class:`_Quarantined` — folds nothing past
+        the hole, and the cursor holds there).  Returns
         ``(payloads, metas)``; skew tolerance and gap errors exactly as
         lib.rs:519-531."""
         payloads, metas = [], []
         local: dict[Actor, int] = overlay if overlay is not None else {}
+        cut: set = blocked if blocked is not None else set()
         for (actor, version, _), clear in zip(files, clears):
+            if actor in cut:
+                continue
             expected = (
                 max(self._data.next_op_versions.get(actor), local.get(actor, 0))
                 + 1
             )
             if version < expected:
                 continue  # concurrent-read tolerance (lib.rs:521-525)
+            if clear is _QUARANTINED:
+                cut.add(actor)  # already counted at the decrypt site
+                continue
             if version > expected:
                 raise OpOrderError(
                     f"op file v{version} for {uuid.UUID(bytes=actor)} arrived "
                     f"beyond expected v{expected}"
                 )
-            inner = VersionBytes.deserialize(clear).ensure_versions(
-                self.supported_data_versions
-            )
+            try:
+                inner = VersionBytes.deserialize(clear).ensure_versions(
+                    self.supported_data_versions
+                )
+            except Exception as e:
+                # decrypted fine but the cleartext framing is damaged
+                # (or a data version this build cannot read): same
+                # quarantine discipline — skip, cut the actor, hold
+                self._note_quarantine("op", f"{actor.hex()}:v{version}", e)
+                cut.add(actor)
+                continue
             payloads.append(inner.content)
             metas.append((actor, version))
             local[actor] = version
@@ -897,10 +1115,11 @@ class Core:
         for actor, version in metas:
             self._data.next_op_versions.apply(Dot(actor, version))
 
-    async def _fold_chunk_python(self, files: list, clears: list) -> None:
+    async def _fold_chunk_python(self, files: list, clears: list,
+                                 blocked: set | None = None) -> None:
         """Per-op fallback fold of one decrypted chunk (non-columnar CRDT
         or a session decline) — bounded by the chunk size."""
-        payloads, metas = self._validate_chunk(files, clears)
+        payloads, metas = self._validate_chunk(files, clears, blocked=blocked)
         if not payloads:
             return
         batch = []
@@ -938,21 +1157,33 @@ class Core:
 
         async def produce():
             ci = 0  # chunk index: span meta, so overlap is event-auditable
+            cut: set = set()  # actors ended by an unwrap quarantine
             try:
                 async for files in self.storage.iter_op_chunks(wanted):
-                    try:
-                        with trace.span("ops.chunk_unwrap", meta=ci):
-                            key_ids, middles = [], []
-                            for _, _, raw in files:
+                    with trace.span("ops.chunk_unwrap", meta=ci):
+                        kept, key_ids, middles = [], [], []
+                        for f in files:
+                            actor, version, raw = f
+                            if actor in cut:
+                                continue
+                            try:
                                 outer = VersionBytes.deserialize(
                                     raw
                                 ).ensure_versions(SUPPORTED_CONTAINER_VERSIONS)
                                 kid, middle = codec.unpack(outer.content)
-                                key_ids.append(bytes(kid))
-                                middles.append(bytes(middle))
-                    except Exception:
-                        await q.put(("abort",))
-                        return
+                            except Exception as e:
+                                # torn outer envelope: quarantine the
+                                # file + end this actor's dense run
+                                # (the cursor holds at the hole)
+                                self._note_quarantine(
+                                    "op", f"{actor.hex()}:v{version}", e
+                                )
+                                cut.add(actor)
+                                continue
+                            kept.append(f)
+                            key_ids.append(bytes(kid))
+                            middles.append(bytes(middle))
+                    files = kept
                     groups: dict[bytes, list[int]] = {}
                     for i, kid in enumerate(key_ids):
                         groups.setdefault(kid, []).append(i)
@@ -966,14 +1197,17 @@ class Core:
                                     f"{uuid.UUID(bytes=kid)}; key metadata "
                                     "may not have synced yet"
                                 )
-                            outs = await self.cryptor.decrypt_batch(
-                                key.material, [middles[i] for i in idxs]
+                            outs = await self._decrypt_tolerant(
+                                key,
+                                [files[i] for i in idxs],
+                                [middles[i] for i in idxs],
                             )
                             for i, clear in zip(idxs, outs):
                                 clears[i] = clear
                     trace.add("bytes_decrypted", sum(len(m) for m in middles))
-                    await q.put(("chunk", files, clears))
-                    ci += 1
+                    if files:
+                        await q.put(("chunk", files, clears))
+                        ci += 1
                 await q.put(("end",))
             except Exception as e:
                 await q.put(("error", e))
@@ -1007,6 +1241,7 @@ class Core:
         session_started = False
         fed_files = 0
         overlay: dict[Actor, int] = {}  # validated-but-unadvanced versions
+        blocked: set[Actor] = set()  # actors cut at a quarantined file
         # decode runs in parallel threads (pure, GIL-released ctypes);
         # reduces drain strictly FIFO so per-actor cursor advancement stays
         # in version order even under a mid-stream failure.  The in-flight
@@ -1054,7 +1289,7 @@ class Core:
                 if not python_mode:
                     await finish_session()
                     python_mode = True
-                await self._fold_chunk_python(files, clears)
+                await self._fold_chunk_python(files, clears, blocked)
                 # later chunks already in flight were validated ahead of
                 # this one — fold them NOW, in order, or a newer chunk
                 # would fold first and trip the version-gap check
@@ -1065,7 +1300,7 @@ class Core:
                         await t2
                     except (asyncio.CancelledError, Exception):
                         pass
-                    await self._fold_chunk_python(f2, c2)
+                    await self._fold_chunk_python(f2, c2, blocked)
                 return
             self._advance_cursors(metas)
             fed_files += len(files)
@@ -1073,9 +1308,11 @@ class Core:
         async def dispatch(files, clears) -> None:
             nonlocal python_mode
             if python_mode:
-                await self._fold_chunk_python(files, clears)
+                await self._fold_chunk_python(files, clears, blocked)
                 return
-            payloads, metas = self._validate_chunk(files, clears, overlay)
+            payloads, metas = self._validate_chunk(
+                files, clears, overlay, blocked
+            )
             if not payloads:
                 return
             task = asyncio.create_task(
@@ -1093,16 +1330,6 @@ class Core:
                     break
                 if tag == "error":
                     raise item[1]
-                if tag == "abort":
-                    # drain the fed prefix, then let the legacy path take
-                    # the remainder (and produce its precise error)
-                    while inflight:
-                        await drain_one()
-                    await finish_session()
-                    for files, clears in pending:
-                        await self._fold_chunk_python(files, clears)
-                    pending = []
-                    return False
                 _, files, clears = item
                 if not session_started and not python_mode:
                     pending.append((files, clears))
@@ -1122,7 +1349,7 @@ class Core:
                 await drain_one()
             await finish_session()
             for files, clears in pending:
-                await self._fold_chunk_python(files, clears)
+                await self._fold_chunk_python(files, clears, blocked)
             pending = []
             return True
         finally:
@@ -1138,13 +1365,13 @@ class Core:
     async def _read_remote_ops_bulk(self, files: list, actors) -> bool:
         """Bulk ingestion: unwrap all outer envelopes, one batched decrypt
         per sealing key, then hand raw payloads to the accelerator's
-        columnar decode+fold.  Returns False (nothing consumed) when the
-        outer framing surprises us, so the per-file path can produce its
-        precise error; key-auth and op-order violations raise exactly as
-        the per-file path would (lib.rs:519-531 semantics preserved)."""
-        groups = self._unwrap_op_files(files, strict=False)
-        if groups is None:
-            return False
+        columnar decode+fold.  Damaged files quarantine per-file (see
+        :class:`_Quarantined`) instead of surprising the ingest; key-auth
+        and op-order violations raise exactly as the per-file path would
+        (lib.rs:519-531 semantics preserved)."""
+        files, groups = self._unwrap_op_files(files)
+        if not files:
+            return True  # every file quarantined: consumed, cursors held
 
         # Single sealing key (the overwhelmingly common case) + a stream-
         # capable accelerator: chunked decrypt with one-chunk lookahead —
@@ -1161,11 +1388,11 @@ class Core:
         payload_chunks: list[list] = []
         metas: list = []
         overlay: dict[Actor, int] = {}
+        barred: set[Actor] = set()  # actors cut at a quarantined file
         streamed_ok = stream is not None
         with trace.span("ops.bulk_decrypt"):
             if stream is not None:
                 (key, idxs, mids), = groups
-                material = key.material
                 CH = BULK_STREAM_CHUNK
                 slices = [idxs[i : i + CH] for i in range(0, len(idxs), CH)]
                 mid_slices = [
@@ -1179,8 +1406,10 @@ class Core:
                     # stream.* stage names the ops/stream.py pipeline and
                     # bench.py --e2e-streaming use)
                     with trace.span("stream.decrypt", meta=si):
-                        return await self.cryptor.decrypt_batch(
-                            material, mid_slices[si]
+                        return await self._decrypt_tolerant(
+                            key,
+                            [files[i] for i in slices[si]],
+                            mid_slices[si],
                         )
 
                 nxt = asyncio.create_task(decrypt_chunk(0))
@@ -1205,7 +1434,8 @@ class Core:
                         # ops behind advanced cursors)
                         with trace.span("stream.validate", meta=si):
                             p, m = self._validate_chunk(
-                                [files[i] for i in sl], clears, overlay
+                                [files[i] for i in sl], clears, overlay,
+                                barred,
                             )
                         metas.extend(m)
                         payload_chunks.append(p)
@@ -1222,12 +1452,12 @@ class Core:
             else:
                 clears: list = [None] * len(files)
                 for key, idxs, mids in groups:
-                    outs = await self.cryptor.decrypt_batch(
-                        key.material, mids
+                    outs = await self._decrypt_tolerant(
+                        key, [files[i] for i in idxs], mids
                     )
                     for i, clear in zip(idxs, outs):
                         clears[i] = clear
-                p, m = self._validate_chunk(files, clears, overlay)
+                p, m = self._validate_chunk(files, clears, overlay, barred)
                 metas.extend(m)
                 payload_chunks.append(p)
         trace.add(
@@ -1262,29 +1492,38 @@ class Core:
         return True
 
     # -------------------------------------------------- serving front end
-    def _unwrap_op_files(self, files: list, *, strict: bool):
+    def _unwrap_op_files(self, files: list):
         """Outer-envelope unwrap of loaded op files, grouped by sealing
-        key: ``[(key, idxs, middles)]`` — ONE implementation of the
-        unwrap → group → key-resolve sequence shared by the whole-batch
-        bulk ingest and the serving front end (a wire or error-message
-        change must have one home).  ``strict=False`` returns None on a
-        framing surprise (the bulk path then re-reads per file for the
-        precise error); ``strict=True`` lets it raise.  An unsynced
-        sealing key raises :class:`MissingKeyError` either way."""
-        try:
-            with trace.span("ops.bulk_unwrap"):
-                key_ids, middles = [], []
-                for _, _, raw in files:
+        key: ``(kept, [(key, idxs, middles)])`` — ONE implementation of
+        the unwrap → group → key-resolve sequence shared by the
+        whole-batch bulk ingest and the serving front end (a wire or
+        error-message change must have one home).  A file whose outer
+        framing does not parse is QUARANTINED (counter + warning, the
+        actor's dense run ends there, cursor held — see
+        :class:`_Quarantined`), so ``kept`` may be shorter than
+        ``files``; ``idxs`` index into ``kept``.  An unsynced sealing
+        key raises :class:`MissingKeyError` — loud, not damage."""
+        with trace.span("ops.bulk_unwrap"):
+            kept, key_ids, middles = [], [], []
+            cut: set = set()
+            for f in files:
+                actor, version, raw = f
+                if actor in cut:
+                    continue
+                try:
                     outer = VersionBytes.deserialize(raw).ensure_versions(
                         SUPPORTED_CONTAINER_VERSIONS
                     )
                     kid, middle = codec.unpack(outer.content)
-                    key_ids.append(bytes(kid))
-                    middles.append(bytes(middle))
-        except Exception:
-            if strict:
-                raise
-            return None
+                except Exception as e:
+                    self._note_quarantine(
+                        "op", f"{actor.hex()}:v{version}", e
+                    )
+                    cut.add(actor)
+                    continue
+                kept.append(f)
+                key_ids.append(bytes(kid))
+                middles.append(bytes(middle))
         by_kid: dict[bytes, list[int]] = {}
         for i, kid in enumerate(key_ids):
             by_kid.setdefault(kid, []).append(i)
@@ -1297,7 +1536,7 @@ class Core:
                     "key metadata may not have synced yet"
                 )
             groups.append((key, idxs, [middles[i] for i in idxs]))
-        return groups
+        return kept, groups
 
     async def load_sealed_ops(self):
         """The multi-tenant serving layer's ingest front end
@@ -1326,7 +1565,8 @@ class Core:
         trace.add("op_files_loaded", len(files))
         if not files:
             return actors, [], []
-        return actors, files, self._unwrap_op_files(files, strict=True)
+        files, groups = self._unwrap_op_files(files)
+        return actors, files, groups
 
     # --------------------------------------------------------------- compact
     async def compact(self) -> None:

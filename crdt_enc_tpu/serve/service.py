@@ -302,18 +302,51 @@ class FoldService:
                 sync_plans.append((w, plans))
 
         def run_sync_plans():
+            from ..core.core import _QUARANTINED
+
             for w, plans in sync_plans:
                 try:
                     clears: list = [None] * len(w.files)
                     for fn, idxs, mids in plans:
-                        for i, clear in zip(idxs, fn(mids)):
+                        try:
+                            outs = fn(mids)
+                        except Exception:
+                            # a damaged blob in the batch: isolate it
+                            # per file — the core's quarantine
+                            # discipline (skip + counter + held
+                            # cursor), not a whole-tenant error.  But
+                            # the WHOLE batch failing is a dead
+                            # cryptor / damaged key, not file damage:
+                            # re-raise into the tenant error (the
+                            # core's _decrypt_tolerant escalation rule)
+                            outs, failed = [], []
+                            for i, m in zip(idxs, mids):
+                                try:
+                                    outs.append(fn([m])[0])
+                                except Exception as e:
+                                    outs.append(_QUARANTINED)
+                                    failed.append((i, e))
+                            if len(mids) > 1 and len(failed) == len(mids):
+                                from ..core.core import IngestDecryptError
+
+                                raise IngestDecryptError(
+                                    f"all {len(mids)} op files in the "
+                                    "tenant batch failed to open"
+                                ) from failed[-1][1]
+                            for i, e in failed:
+                                actor, version, _ = w.files[i]
+                                w.core._note_quarantine(
+                                    "op",
+                                    f"{actor.hex()}:v{version}", e,
+                                )
+                        for i, clear in zip(idxs, outs):
                             clears[i] = clear
                     w.clears = clears
                     trace.add(
                         "bytes_decrypted",
                         sum(len(m) for _, _, mids in plans for m in mids),
                     )
-                except Exception as e:  # e.g. AeadError — tenant-local
+                except Exception as e:  # tenant-local (plan-level surprise)
                     w.result.error = repr(e)
                     w.result.path = "error"
 
@@ -325,8 +358,10 @@ class FoldService:
                 with trace.span("serve.decrypt", meta=w.idx):
                     clears = [None] * len(w.files)
                     for key, idxs, mids in w.groups:
-                        outs = await w.core.cryptor.decrypt_batch(
-                            key.material, mids
+                        # per-file quarantine on damage, exactly the
+                        # solo bulk path's discipline
+                        outs = await w.core._decrypt_tolerant(
+                            key, [w.files[i] for i in idxs], mids
                         )
                         for i, clear in zip(idxs, outs):
                             clears[i] = clear

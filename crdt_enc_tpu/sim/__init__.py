@@ -1,0 +1,37 @@
+"""Adversarial convergence simulator (docs/simulation.md).
+
+Machine-checks the PROTOCOL invariants — convergence, oracle equality,
+warm≡cold reopen, replication monotonicity, fsck cleanliness — under
+hostile, deterministic, seeded schedules of replica activity over a
+fault-injecting storage layer, the way the analysis engine (PR 5)
+machine-checks code invariants.  Failures shrink to minimal replayable
+fixtures committed under ``tests/data/sim/``.
+
+Front doors: :func:`generate` a schedule, :func:`run_schedule` it,
+:func:`shrink` a failure; ``python -m crdt_enc_tpu.tools.sim`` is the
+CLI over the same calls.
+"""
+
+from .check import InvariantViolation, Violation
+from .faults import FaultConfig, FaultyStorage, SimCrash
+from .runner import DeterministicCryptor, SimResult, SimRunner, run_schedule
+from .schedule import STEP_KINDS, Schedule, Step, generate
+from .shrink import shrink, to_fixture
+
+__all__ = [
+    "FaultConfig",
+    "FaultyStorage",
+    "InvariantViolation",
+    "DeterministicCryptor",
+    "STEP_KINDS",
+    "Schedule",
+    "SimCrash",
+    "SimResult",
+    "SimRunner",
+    "Step",
+    "Violation",
+    "generate",
+    "run_schedule",
+    "shrink",
+    "to_fixture",
+]

@@ -1,0 +1,569 @@
+"""Schedule execution: real Cores, one hostile remote, full checks.
+
+Runs a :class:`~crdt_enc_tpu.sim.schedule.Schedule` against a fleet of
+REAL :class:`~crdt_enc_tpu.core.Core` instances — host-oracle replicas,
+``TpuAccelerator`` replicas, and :class:`~crdt_enc_tpu.serve.FoldService`
+cycles all in the same history — sharing one remote through per-replica
+:class:`~crdt_enc_tpu.sim.faults.FaultyStorage` wrappers.  No mocks on
+the system-under-test side: every byte travels the production wire
+format and every fold runs the production paths.
+
+Determinism: with the default memory backend the whole run is a pure
+function of the schedule.  Besides the seeded fault rolls, the two real
+entropy sources are patched for the run's duration — ``uuid.uuid4``
+(actor and key ids) draws from a schedule-seeded stream, and key
+material comes from :class:`DeterministicCryptor` — so fault patterns,
+file names, and final states replay bit-for-bit
+(``SimResult.fingerprint`` pins it).  The fs backend keeps thread-pool
+timing, so it is exercised for coverage, not replay fidelity.
+
+Error discipline while faults are active:
+
+* :class:`SimCrash` from a write step = that replica crashed — its Core
+  is discarded and storage keeps whatever landed (later ``reopen``);
+* :class:`MissingKeyError` / :class:`StaleWriterError` /
+  :class:`IngestDecryptError` = documented loud-but-transient states
+  (key metadata, own history, or a whole batch of blobs not yet synced
+  intact); the step is a no-op and the occurrence is counted;
+* anything else is a **violation** (kind ``step_error``): the fault
+  classes are all survivable by design, so an unexpected exception is a
+  robustness bug, exactly what the simulator hunts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import random
+import uuid
+from collections import Counter
+from dataclasses import dataclass, field
+
+from ..backends.identity_crypto import IdentityCryptor
+from ..core import (
+    Core,
+    IngestDecryptError,
+    MissingKeyError,
+    OpenOptions,
+    StaleWriterError,
+)
+from ..utils import trace
+from ..utils.versions import IDENTITY_KEY_VERSION_1
+from .check import (
+    InvariantViolation,
+    Violation,
+    divergence_detail,
+    known_replica_set,
+    replication_regression,
+)
+from .faults import FaultyStorage, SimCrash
+from .schedule import Schedule
+
+logger = logging.getLogger("crdt_enc_tpu.sim")
+
+QUIESCE_MAX_ROUNDS = 8
+WARM_COLD_SAMPLES = 2  # replicas per quiescence given the warm≡cold check
+
+
+class DeterministicCryptor(IdentityCryptor):
+    """Identity cryptor with seeded key material, so key registers —
+    and therefore every content-addressed file name — replay exactly."""
+
+    def __init__(self, seed: str):
+        self._rng = random.Random(f"crdt-sim-key-{seed}")
+
+    async def gen_key(self):
+        from ..utils import VersionBytes
+
+        return VersionBytes(
+            IDENTITY_KEY_VERSION_1, self._rng.getrandbits(256).to_bytes(32, "big")
+        )
+
+
+@contextlib.contextmanager
+def _deterministic_uuid(seed: int):
+    """Patch ``uuid.uuid4`` to a schedule-seeded stream for the run:
+    actor ids and key ids are the only remaining entropy behind file
+    names and sort orders.  Restored on exit; the simulator is a test
+    harness and runs single-threaded per process."""
+    rng = random.Random(f"crdt-sim-uuid-{seed}")
+    orig = uuid.uuid4
+    uuid.uuid4 = lambda: uuid.UUID(int=rng.getrandbits(128), version=4)
+    try:
+        yield
+    finally:
+        uuid.uuid4 = orig
+
+
+@dataclass
+class SimResult:
+    violation: Violation | None
+    steps_run: int = 0
+    checks_run: int = 0
+    fault_stats: Counter = field(default_factory=Counter)
+    transient_missing_key: int = 0
+    service_cycles: int = 0
+    quarantined: int = 0
+    fingerprint: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+@dataclass
+class _Replica:
+    idx: int
+    storage: FaultyStorage
+    core: Core | None = None
+    incarnation: int = 0
+    last_status: dict | None = None  # per-incarnation monotonicity baseline
+
+
+class SimRunner:
+    """One schedule execution.  ``tmpdir`` is required for the fs
+    backend (the shared remote + per-replica local dirs live under it);
+    the memory backend ignores it."""
+
+    def __init__(self, schedule: Schedule, *, tmpdir: str | None = None):
+        self.schedule = schedule
+        self.tmpdir = tmpdir
+        self.replicas: list[_Replica] = []
+        self.members = [
+            f"member-{i}".encode() for i in range(schedule.members)
+        ]
+        self.transient_missing_key = 0
+        self.service_cycles = 0
+        self.checks_run = 0
+        self._remote = None  # memory backend's shared MemoryRemote
+
+    # ----------------------------------------------------------- plumbing
+    def _inner_storage(self, idx: int):
+        if self.schedule.backend == "memory":
+            from ..backends.memory import MemoryRemote, MemoryStorage
+
+            if self._remote is None:
+                self._remote = MemoryRemote()
+            return MemoryStorage(self._remote)
+        if self.tmpdir is None:
+            raise ValueError("fs backend needs a tmpdir")
+        from ..backends.fs import FsStorage
+
+        return FsStorage(
+            os.path.join(self.tmpdir, f"r{idx}"),
+            os.path.join(self.tmpdir, "remote"),
+        )
+
+    def _clean_storage(self, label: str):
+        """A fresh, fault-free storage over the same remote (oracle,
+        fsck): its local side is private scratch."""
+        if self.schedule.backend == "memory":
+            from ..backends.memory import MemoryStorage
+
+            return MemoryStorage(self._remote)
+        from ..backends.fs import FsStorage
+
+        return FsStorage(
+            os.path.join(self.tmpdir, f"check-{label}"),
+            os.path.join(self.tmpdir, "remote"),
+        )
+
+    def _accel(self, idx: int):
+        # odd replicas fold on the accelerator, even on the host
+        # reference — both execution paths face every history
+        if idx % 2 == 1:
+            from ..parallel import TpuAccelerator
+
+            return {"accelerator": TpuAccelerator(min_device_batch=1)}
+        return {}
+
+    def _opts(self, rep: _Replica, *, create: bool, storage=None,
+              checkpoint: bool = True, host: bool = False) -> OpenOptions:
+        from ..core import orset_adapter
+        from ..backends.plain_keys import PlainKeyCryptor
+        from ..utils.versions import DEFAULT_DATA_VERSION_1
+
+        accel = {} if host else self._accel(rep.idx)
+        return OpenOptions(
+            storage=storage if storage is not None else rep.storage,
+            cryptor=DeterministicCryptor(f"{self.schedule.seed}:{rep.idx}"),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=create,
+            checkpoint=checkpoint,
+            **accel,
+        )
+
+    async def _open(self, rep: _Replica, *, create: bool) -> None:
+        rep.core = await Core.open(self._opts(rep, create=create))
+        rep.incarnation += 1
+        rep.last_status = None  # monotonicity holds per incarnation
+
+    # --------------------------------------------------------------- run
+    def run(self) -> SimResult:
+        """Execute the schedule + final quiescence check.  Returns a
+        :class:`SimResult`; protocol violations land on
+        ``result.violation`` instead of raising, so the shrinker and
+        the CLI share one calling convention."""
+        with _deterministic_uuid(self.schedule.seed):
+            return asyncio.run(self._run())
+
+    async def _run(self) -> SimResult:
+        sched = self.schedule
+        trace.add("sim_schedules", 1)
+        result = SimResult(violation=None)
+        with trace.span("sim.run", meta=sched.seed):
+            for i in range(sched.n_replicas):
+                inner = self._inner_storage(i)
+                wrapper = FaultyStorage(
+                    inner, sched.faults, seed=sched.seed, name=f"r{i}"
+                )
+                rep = _Replica(i, wrapper)
+                self.replicas.append(rep)
+            # bootstrap with faults off: a fleet that cannot even form
+            # (e.g. every replica's key bootstrap crashes) explores
+            # nothing — the adversary starts once the fleet exists
+            for rep in self.replicas:
+                rep.storage.heal()
+            for rep in self.replicas:
+                await self._open(rep, create=True)
+            for rep in self.replicas:
+                rep.storage.arm()
+
+            q0 = int(trace.snapshot()["counters"].get("ingest_quarantined", 0))
+            try:
+                for step_idx, step in enumerate(sched.steps):
+                    result.steps_run = step_idx + 1
+                    trace.add("sim_steps", 1)
+                    with trace.span("sim.step", meta=step_idx):
+                        violation = await self._exec(step, step_idx)
+                    if violation is not None:
+                        result.violation = violation
+                        break
+                if result.violation is None:
+                    result.violation = await self._quiesce_and_check(
+                        len(sched.steps)
+                    )
+            except InvariantViolation as iv:
+                result.violation = iv.violation
+        for rep in self.replicas:
+            result.fault_stats.update(rep.storage.stats)
+        trace.add(
+            "sim_faults_injected", sum(result.fault_stats.values())
+        )
+        if result.violation is not None:
+            trace.add("sim_violations", 1)
+        result.transient_missing_key = self.transient_missing_key
+        result.service_cycles = self.service_cycles
+        result.checks_run = self.checks_run
+        result.quarantined = (
+            int(trace.snapshot()["counters"].get("ingest_quarantined", 0)) - q0
+        )
+        result.fingerprint = self._fingerprint(result)
+        return result
+
+    def _fingerprint(self, result: SimResult) -> str:
+        """Digest of everything a deterministic replay must reproduce:
+        final states, cursors, and the injected-fault tallies."""
+        from ..models import canonical_bytes
+
+        h = hashlib.sha256()
+        for rep in self.replicas:
+            if rep.core is not None:
+                h.update(rep.core.with_state(canonical_bytes))
+                h.update(
+                    json.dumps(
+                        sorted(
+                            (a.hex(), v)
+                            for a, v in
+                            rep.core.info().next_op_versions.counters.items()
+                        )
+                    ).encode()
+                )
+        h.update(json.dumps(sorted(result.fault_stats.items())).encode())
+        return h.hexdigest()
+
+    # -------------------------------------------------------------- steps
+    async def _exec(self, step, step_idx: int) -> Violation | None:
+        rep = self.replicas[step.replica] if step.replica < len(self.replicas) else None
+        kind = step.kind
+        if kind == "tick":
+            for r in self.replicas:
+                r.storage.tick()
+            return None
+        if kind == "quiesce":
+            violation = await self._quiesce_and_check(step_idx)
+            for r in self.replicas:
+                r.storage.arm()
+            return violation
+        if kind == "reopen":
+            if rep.core is None:
+                try:
+                    await self._open(rep, create=False)
+                except SimCrash:
+                    pass  # crashed again mid-reopen; stays dead
+                except MissingKeyError:
+                    self.transient_missing_key += 1
+            return None
+        if rep is None or rep.core is None:
+            return None  # steps on dead replicas are no-ops (shrink-safe)
+        if kind == "crash":
+            # the process dies mid-anything: memory state discarded,
+            # storage keeps exactly what landed
+            rep.core = None
+            return None
+        try:
+            if kind == "add":
+                m = self.members[step.arg % len(self.members)]
+                core = rep.core
+                await core.update(lambda s: s.add_ctx(core.actor_id, m))
+            elif kind == "rm":
+                m = self.members[step.arg % len(self.members)]
+                await rep.core.update(
+                    lambda s: s.rm_ctx(m) if s.contains(m) else None
+                )
+            elif kind == "read":
+                await rep.core.read_remote()
+            elif kind == "compact":
+                await rep.core.compact()
+            elif kind == "rotate":
+                await rep.core.rotate_key()
+            elif kind == "compact2":
+                return await self._compact2(rep, step.arg, step_idx)
+            elif kind == "service":
+                return await self._service(rep, step.arg, step_idx)
+            else:
+                raise ValueError(f"unknown step kind {kind!r}")
+        except SimCrash:
+            rep.core = None
+        except (MissingKeyError, StaleWriterError, IngestDecryptError):
+            # documented loud-but-transient states: key metadata / own
+            # history not yet visible, or a whole batch of torn blobs
+            # (the escalation rule fires loudly; the sim's tears ARE
+            # transient, so the step is simply retried later)
+            self.transient_missing_key += 1
+        except Exception as e:
+            logger.warning(
+                "sim step %d (%s on r%d) failed", step_idx, kind, rep.idx,
+                exc_info=True,
+            )
+            return Violation("step_error", f"{kind} on r{rep.idx}: {e!r}", step_idx)
+        return None
+
+    async def _compact2(self, rep, peer_idx: int, step_idx: int) -> Violation | None:
+        """Two replicas compact the same remote CONCURRENTLY."""
+        peer = self.replicas[peer_idx]
+        targets = [rep] if peer.core is None or peer is rep else [rep, peer]
+        outcomes = await asyncio.gather(
+            *(r.core.compact() for r in targets), return_exceptions=True
+        )
+        for r, out in zip(targets, outcomes):
+            if isinstance(out, SimCrash):
+                r.core = None
+            elif isinstance(out, (MissingKeyError, IngestDecryptError)):
+                self.transient_missing_key += 1
+            elif isinstance(out, BaseException):
+                logger.warning(
+                    "sim step %d concurrent compact on r%d failed: %r",
+                    step_idx, r.idx, out,
+                )
+                return Violation(
+                    "step_error",
+                    f"concurrent compact on r{r.idx}: {out!r}",
+                    step_idx,
+                )
+        return None
+
+    async def _service(self, rep, peer_idx: int, step_idx: int) -> Violation | None:
+        """A FoldService cycle compacts 1-2 replicas as tenants — the
+        serving layer's sealing path in the same hostile history as the
+        solo compactors."""
+        from ..serve import FoldService, ServeConfig
+
+        peer = self.replicas[peer_idx]
+        tenants = [rep]
+        if peer is not rep and peer.core is not None:
+            tenants.append(peer)
+        service = FoldService(
+            [t.core for t in tenants], ServeConfig(seal_empty=True)
+        )
+        results = await service.run_cycle()
+        self.service_cycles += 1
+        for t, res in zip(tenants, results):
+            if res.error is None:
+                continue
+            if "SimCrash" in res.error:
+                t.core = None
+            elif (
+                "MissingKeyError" in res.error
+                or "IngestDecryptError" in res.error
+            ):
+                self.transient_missing_key += 1
+            else:
+                return Violation(
+                    "service_error",
+                    f"tenant r{t.idx}: {res.error}",
+                    step_idx,
+                )
+        return None
+
+    # -------------------------------------------------------- quiescence
+    async def _quiesce_and_check(self, step_idx: int) -> Violation | None:
+        """Heal, drain to a read fixed point, run every invariant."""
+        from ..models import canonical_bytes
+
+        with trace.span("sim.check", meta=step_idx):
+            self.checks_run += 1
+            for rep in self.replicas:
+                rep.storage.heal()
+            for rep in self.replicas:
+                if rep.core is None:
+                    try:
+                        await self._open(rep, create=False)
+                    except MissingKeyError:
+                        return Violation(
+                            "step_error",
+                            f"r{rep.idx} missing key AFTER heal",
+                            step_idx,
+                        )
+            prev = None
+            for _ in range(QUIESCE_MAX_ROUNDS):
+                for rep in self.replicas:
+                    await rep.core.read_remote()
+                snap = [
+                    (
+                        rep.core.with_state(canonical_bytes),
+                        tuple(
+                            sorted(
+                                rep.core.info().next_op_versions.counters.items()
+                            )
+                        ),
+                    )
+                    for rep in self.replicas
+                ]
+                if snap == prev and len({s[0] for s in snap}) == 1:
+                    break
+                prev = snap
+            else:
+                detail = divergence_detail(
+                    [
+                        (f"r{rep.idx}", rep.core.with_state(canonical_bytes))
+                        for rep in self.replicas
+                    ]
+                )
+                return Violation(
+                    "no_quiescence",
+                    detail or "reads never reached a fixed point",
+                    step_idx,
+                )
+            blobs = [
+                (f"r{rep.idx}", rep.core.with_state(canonical_bytes))
+                for rep in self.replicas
+            ]
+            detail = divergence_detail(blobs)
+            if detail is not None:
+                return Violation("divergence", detail, step_idx)
+            reference = blobs[0][1]
+
+            v = await self._check_oracle(reference, step_idx)
+            if v is None:
+                v = await self._check_warm_cold(reference, step_idx)
+            if v is None:
+                v = await self._check_monotonicity(step_idx)
+            if v is None:
+                v = await self._check_fsck(step_idx)
+            return v
+
+    async def _check_oracle(self, reference: bytes, step_idx: int):
+        from ..models import canonical_bytes
+
+        rep0 = self.replicas[0]
+        oracle = await Core.open(
+            self._opts(
+                rep0, create=True,
+                storage=self._clean_storage(f"oracle{self.checks_run}"),
+                checkpoint=False, host=True,
+            )
+        )
+        await oracle.read_remote()
+        if oracle.with_state(canonical_bytes) != reference:
+            return Violation(
+                "oracle",
+                "fresh host refold of the remote diverges from the fleet",
+                step_idx,
+            )
+        return None
+
+    async def _check_warm_cold(self, reference: bytes, step_idx: int):
+        from ..models import canonical_bytes
+
+        checked = 0
+        for rep in self.replicas:
+            if checked >= WARM_COLD_SAMPLES:
+                break
+            checked += 1
+            warm = await Core.open(self._opts(rep, create=False))
+            await warm.read_remote()
+            cold = await Core.open(
+                self._opts(rep, create=False, checkpoint=False)
+            )
+            await cold.read_remote()
+            wb = warm.with_state(canonical_bytes)
+            cb = cold.with_state(canonical_bytes)
+            if wb != cb or wb != reference:
+                return Violation(
+                    "warm_cold",
+                    f"r{rep.idx}: warm-open {'==' if wb == cb else '!='} "
+                    f"cold-open, fleet match warm={wb == reference} "
+                    f"cold={cb == reference} "
+                    f"(fallback={warm.checkpoint_fallback_reason})",
+                    step_idx,
+                )
+        return None
+
+    async def _check_monotonicity(self, step_idx: int):
+        for rep in self.replicas:
+            status = await rep.core.replication_status()
+            defect = replication_regression(rep.last_status, status)
+            if defect is not None:
+                return Violation(
+                    "monotonicity", f"r{rep.idx}: {defect}", step_idx
+                )
+            if rep.last_status is not None and known_replica_set(
+                status
+            ) < known_replica_set(rep.last_status):
+                return Violation(
+                    "monotonicity",
+                    f"r{rep.idx}: known replica set shrank",
+                    step_idx,
+                )
+            rep.last_status = status
+        return None
+
+    async def _check_fsck(self, step_idx: int):
+        from ..backends.plain_keys import PlainKeyCryptor
+        from ..tools.fsck import fsck_remote
+
+        report = await fsck_remote(
+            self._clean_storage(f"fsck{self.checks_run}"),
+            DeterministicCryptor("fsck"),
+            PlainKeyCryptor(),
+            deep=True,
+        )
+        if not report.ok:
+            issues = "; ".join(
+                str(i) for i in report.issues if i.severity == "error"
+            )
+            return Violation("fsck", issues[:500], step_idx)
+        return None
+
+
+def run_schedule(schedule: Schedule, *, tmpdir: str | None = None) -> SimResult:
+    """Convenience front door: one runner, one result."""
+    return SimRunner(schedule, tmpdir=tmpdir).run()

@@ -1,0 +1,219 @@
+"""Seeded schedules: the explorable space of replica interleavings.
+
+A :class:`Schedule` is a fully materialized, JSON-serializable program
+for the simulator: N replicas, a step list, and a fault configuration.
+:func:`generate` derives one deterministically from a seed — no wall
+clock, no global RNG — so ``(seed, replicas, steps, faults)`` names one
+exact history and a failure found at fleet scale replays bit-for-bit
+from four numbers.  Shrunk failures serialize through
+:meth:`Schedule.to_obj` into the committed fixtures under
+``tests/data/sim/`` (docs/simulation.md).
+
+Step kinds (``Step.kind``):
+
+========== ==================================================================
+``add``     replica adds member ``arg`` to the OR-Set
+``rm``      replica removes member ``arg`` (no-op when absent)
+``read``    replica ``read_remote()``
+``compact`` replica ``compact()``
+``compact2`` replicas ``replica`` and ``arg`` compact CONCURRENTLY
+``service`` a :class:`~crdt_enc_tpu.serve.FoldService` cycle compacts
+            replica ``replica`` (and ``arg`` when different) as tenants
+``rotate``  replica rotates the data key mid-sync
+``crash``   replica crashes (Core discarded; storage keeps what landed)
+``reopen``  replica reopens from its local dir (warm checkpoint in play)
+``tick``    one sync tick on every replica's fault wrapper (delayed
+            files move toward visibility)
+``quiesce`` mid-run quiescence point: heal, drain, run the full
+            invariant check, then re-arm the faults
+========== ==================================================================
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from .faults import FaultConfig
+
+SCHEDULE_VERSION = 1
+
+STEP_KINDS = (
+    "add",
+    "rm",
+    "read",
+    "compact",
+    "compact2",
+    "service",
+    "rotate",
+    "crash",
+    "reopen",
+    "tick",
+    "quiesce",
+)
+
+
+@dataclass
+class Step:
+    kind: str
+    replica: int = 0
+    arg: int = 0
+
+    def to_obj(self):
+        return [self.kind, self.replica, self.arg]
+
+    @classmethod
+    def from_obj(cls, obj) -> "Step":
+        kind, replica, arg = obj
+        if kind not in STEP_KINDS:
+            raise ValueError(f"unknown step kind {kind!r}")
+        return cls(str(kind), int(replica), int(arg))
+
+
+@dataclass
+class Schedule:
+    seed: int
+    n_replicas: int
+    steps: list = field(default_factory=list)
+    faults: FaultConfig = field(default_factory=FaultConfig)
+    members: int = 12
+    backend: str = "memory"  # "memory" (deterministic) | "fs"
+    note: str = ""
+
+    def to_obj(self) -> dict:
+        return {
+            "v": SCHEDULE_VERSION,
+            "seed": self.seed,
+            "replicas": self.n_replicas,
+            "members": self.members,
+            "backend": self.backend,
+            "faults": self.faults.to_obj(),
+            "steps": [s.to_obj() for s in self.steps],
+            "note": self.note,
+        }
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "Schedule":
+        v = obj.get("v")
+        if v != SCHEDULE_VERSION:
+            raise ValueError(f"unsupported schedule version {v!r}")
+        backend = obj.get("backend", "memory")
+        if backend not in ("memory", "fs"):
+            raise ValueError(f"unknown backend {backend!r}")
+        sched = cls(
+            seed=int(obj["seed"]),
+            n_replicas=int(obj["replicas"]),
+            steps=[Step.from_obj(s) for s in obj["steps"]],
+            faults=FaultConfig.from_obj(obj.get("faults", {})),
+            members=int(obj.get("members", 12)),
+            backend=backend,
+            note=str(obj.get("note", "")),
+        )
+        bad = [
+            s for s in sched.steps
+            if not (0 <= s.replica < sched.n_replicas)
+            or (s.kind in ("compact2", "service")
+                and not (0 <= s.arg < sched.n_replicas))
+        ]
+        if bad:
+            raise ValueError(f"steps reference replicas out of range: {bad[:3]}")
+        return sched
+
+    def with_steps(self, steps: list) -> "Schedule":
+        return Schedule(
+            seed=self.seed,
+            n_replicas=self.n_replicas,
+            steps=list(steps),
+            faults=self.faults,
+            members=self.members,
+            backend=self.backend,
+            note=self.note,
+        )
+
+    def with_faults(self, faults: FaultConfig) -> "Schedule":
+        sched = self.with_steps(self.steps)
+        sched.faults = faults
+        return sched
+
+
+# step-kind weights: mostly writes and syncs, a steady trickle of the
+# hostile moves (concurrent compactors, service cycles, rotation,
+# crashes).  ``reopen`` weight applies only while someone is dead —
+# the generator tracks liveness so schedules stay well-formed.
+_WEIGHTS = [
+    ("add", 0.34),
+    ("rm", 0.10),
+    ("read", 0.16),
+    ("compact", 0.09),
+    ("compact2", 0.03),
+    ("service", 0.04),
+    ("rotate", 0.02),
+    ("crash", 0.03),
+    ("reopen", 0.05),
+    ("tick", 0.12),
+    ("quiesce", 0.02),
+]
+
+
+def generate(
+    seed: int,
+    n_replicas: int,
+    n_steps: int,
+    faults: FaultConfig,
+    *,
+    members: int = 12,
+    backend: str = "memory",
+) -> Schedule:
+    """One deterministic schedule from a seed.  Every replica both
+    writes and syncs; dead replicas receive only ``reopen`` steps; the
+    final step list always ends in enough reopens that the quiescence
+    phase starts with a full fleet."""
+    rng = random.Random(f"crdt-sim-{seed}")
+    kinds = [k for k, _ in _WEIGHTS]
+    weights = [w for _, w in _WEIGHTS]
+    dead: set[int] = set()
+    steps: list[Step] = []
+    for _ in range(n_steps):
+        kind = rng.choices(kinds, weights)[0]
+        if kind == "reopen":
+            if not dead:
+                kind = "read"
+        elif kind == "crash" and len(dead) >= max(1, n_replicas // 2):
+            kind = "tick"  # keep a quorum alive so histories stay dense
+        if kind == "tick":
+            steps.append(Step("tick"))
+            continue
+        if kind == "quiesce":
+            steps.append(Step("quiesce"))
+            dead.clear()  # quiescence reopens every dead replica
+            continue
+        if kind == "reopen":
+            r = rng.choice(sorted(dead))
+            dead.discard(r)
+            steps.append(Step("reopen", r))
+            continue
+        alive = [i for i in range(n_replicas) if i not in dead]
+        if not alive:
+            steps.append(Step("tick"))
+            continue
+        r = rng.choice(alive)
+        if kind == "crash":
+            dead.add(r)
+            steps.append(Step("crash", r))
+        elif kind in ("add", "rm"):
+            steps.append(Step(kind, r, rng.randrange(members)))
+        elif kind in ("compact2", "service"):
+            peer = rng.choice(alive)
+            steps.append(Step(kind, r, peer))
+        else:
+            steps.append(Step(kind, r))
+    for r in sorted(dead):
+        steps.append(Step("reopen", r))
+    return Schedule(
+        seed=seed,
+        n_replicas=n_replicas,
+        steps=steps,
+        faults=faults,
+        members=members,
+        backend=backend,
+    )

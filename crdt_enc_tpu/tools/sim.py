@@ -1,0 +1,219 @@
+"""Adversarial convergence simulator CLI (docs/simulation.md).
+
+    python -m crdt_enc_tpu.tools.sim run --seed 42 --replicas 8 \
+        --steps 500 --faults all [--backend memory|fs] [--shrink OUT.json]
+    python -m crdt_enc_tpu.tools.sim explore --seeds 0:20 --replicas 4 \
+        --steps 120 --faults all
+    python -m crdt_enc_tpu.tools.sim replay tests/data/sim [FILE.json ...]
+
+``run`` executes one seeded schedule and checks every quiescence
+invariant; on failure, ``--shrink`` delta-debugs the schedule to a
+minimal reproducer and writes a replayable fixture.  ``explore`` sweeps
+a seed range.  ``replay`` runs committed fixtures (directories expand
+to their ``*.json``) and exits non-zero if any regresses — every file
+under ``tests/data/sim/`` is a fixed bug's permanent regression test,
+and a non-fixture file in that directory is an error (nothing in the
+fixture dir may be silently unreferenced).
+
+Exit codes: 0 all invariants held, 1 violation (or fixture regression),
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def _build_faults(spec: str):
+    from ..sim import FaultConfig
+
+    if spec == "all":
+        return FaultConfig.all_faults()
+    if spec == "none":
+        return FaultConfig.none()
+    chosen = [c.strip() for c in spec.split(",") if c.strip()]
+    full = FaultConfig.all_faults()
+    cfg = FaultConfig.none()
+    for c in chosen:
+        if c not in FaultConfig.CLASSES:
+            raise SystemExit(
+                f"unknown fault class {c!r}; choose from "
+                f"{', '.join(FaultConfig.CLASSES)}, or all/none"
+            )
+        setattr(cfg, c, getattr(full, c))
+    cfg.delay_max_ticks = full.delay_max_ticks
+    return cfg
+
+
+def _execute(schedule):
+    """One schedule run; fs schedules get a fresh scratch dir (a reused
+    dir would leak one run's remote into the next)."""
+    from ..sim import run_schedule
+
+    if schedule.backend == "fs":
+        with tempfile.TemporaryDirectory(prefix="crdt-sim-") as td:
+            return run_schedule(schedule, tmpdir=td)
+    return run_schedule(schedule)
+
+
+def _report(tag: str, schedule, result) -> None:
+    stats = ", ".join(
+        f"{k}={v}" for k, v in sorted(result.fault_stats.items())
+    ) or "none"
+    print(
+        f"{tag}: seed={schedule.seed} replicas={schedule.n_replicas} "
+        f"steps={result.steps_run} checks={result.checks_run} "
+        f"service_cycles={result.service_cycles} "
+        f"quarantined={result.quarantined} faults[{stats}]"
+    )
+    if result.violation is not None:
+        v = result.violation
+        print(f"  VIOLATION [{v.invariant}] at step {v.step}: {v.detail}")
+
+
+def _cmd_run(args) -> int:
+    from ..sim import generate, shrink, to_fixture
+
+    faults = _build_faults(args.faults)
+    schedule = generate(
+        args.seed, args.replicas, args.steps, faults,
+        members=args.members, backend=args.backend,
+    )
+    result = _execute(schedule)
+    _report("run", schedule, result)
+    if result.ok:
+        return 0
+    if args.shrink:
+        small, violation = shrink(
+            schedule, result.violation, _execute, max_runs=args.shrink_budget
+        )
+        fixture = to_fixture(small, violation)
+        with open(args.shrink, "w") as f:
+            json.dump(fixture, f, indent=1)
+            f.write("\n")
+        print(
+            f"  shrunk to {len(small.steps)} steps / "
+            f"{small.n_replicas} replicas / faults "
+            f"{small.faults.enabled_classes()} -> {args.shrink}"
+        )
+    return 1
+
+
+def _cmd_explore(args) -> int:
+    from ..sim import generate
+
+    try:
+        lo, hi = (int(x) for x in args.seeds.split(":"))
+    except ValueError:
+        raise SystemExit(f"--seeds wants LO:HI, got {args.seeds!r}")
+    faults = _build_faults(args.faults)
+    failures = 0
+    for seed in range(lo, hi):
+        schedule = generate(
+            seed, args.replicas, args.steps, faults,
+            members=args.members, backend=args.backend,
+        )
+        result = _execute(schedule)
+        _report(f"seed {seed}", schedule, result)
+        if not result.ok:
+            failures += 1
+    print(f"explore: {hi - lo} schedules, {failures} failure(s)")
+    return 1 if failures else 0
+
+
+def _expand_fixtures(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            entries = sorted(os.listdir(p))
+            stray = [e for e in entries if not e.endswith(".json")]
+            if stray:
+                raise SystemExit(
+                    f"non-fixture files in {p}: {stray} — every file in a "
+                    "fixture dir must be a replayable .json schedule"
+                )
+            out.extend(os.path.join(p, e) for e in entries)
+        else:
+            out.append(p)
+    return out
+
+
+def _cmd_replay(args) -> int:
+    from ..sim import Schedule
+
+    files = _expand_fixtures(args.fixtures)
+    if not files:
+        print("replay: no fixtures found", file=sys.stderr)
+        return 2
+    regressions = 0
+    for path in files:
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+            schedule = Schedule.from_obj(obj)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"{path}: unreadable fixture: {e!r}", file=sys.stderr)
+            return 2
+        result = _execute(schedule)
+        was = obj.get("violation", {}).get("invariant", "?")
+        if result.ok:
+            print(f"{path}: PASS (was: {was})")
+        else:
+            regressions += 1
+            v = result.violation
+            print(
+                f"{path}: REGRESSED [{v.invariant}] {v.detail}",
+                file=sys.stderr,
+            )
+    print(f"replay: {len(files)} fixture(s), {regressions} regression(s)")
+    return 1 if regressions else 0
+
+
+def main(argv=None) -> int:
+    # protocol-level simulation: tiny states, thousands of dispatches —
+    # the CPU backend is the right tool even on a TPU box (override by
+    # exporting JAX_PLATFORMS yourself)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m crdt_enc_tpu.tools.sim", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--replicas", type=int, default=4)
+        p.add_argument("--steps", type=int, default=120)
+        p.add_argument("--members", type=int, default=12)
+        p.add_argument("--faults", default="all",
+                       help="all | none | comma-list of fault classes")
+        p.add_argument("--backend", choices=("memory", "fs"),
+                       default="memory")
+
+    p_run = sub.add_parser("run", help="one seeded schedule + checks")
+    p_run.add_argument("--seed", type=int, default=0)
+    common(p_run)
+    p_run.add_argument("--shrink", metavar="OUT.json",
+                       help="on failure, ddmin to a minimal fixture")
+    p_run.add_argument("--shrink-budget", type=int, default=200)
+    p_run.set_defaults(fn=_cmd_run)
+
+    p_exp = sub.add_parser("explore", help="sweep a seed range")
+    p_exp.add_argument("--seeds", default="0:10", metavar="LO:HI")
+    common(p_exp)
+    p_exp.set_defaults(fn=_cmd_explore)
+
+    p_rep = sub.add_parser("replay", help="replay committed fixtures")
+    p_rep.add_argument("fixtures", nargs="+",
+                       help="fixture .json files and/or directories")
+    p_rep.set_defaults(fn=_cmd_replay)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

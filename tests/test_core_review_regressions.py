@@ -97,14 +97,20 @@ def test_unknown_key_is_loud_not_silent():
 
 
 def test_producer_cursor_survives_restart(tmp_path):
-    """Write, compact, 'restart' the process, write again WITHOUT
-    read_remote: the new op file must land past the compacted range so
-    consumers whose scan cursor is already beyond v1 still find it.
-    (Without the durable cursor it lands at v1 and is invisible to them
-    forever — the silent-loss scenario.)  Checkpointing is disabled for
-    the restart: this test pins the durable-CURSOR guarantee on a cold
-    open with empty state (the warm-open twin below pins the
-    checkpointed restart, where increments continue)."""
+    """Write, compact, 'restart' the process, write again WITHOUT an
+    explicit read_remote: the new op file must land past the compacted
+    range so consumers whose scan cursor is already beyond v1 still
+    find it.  (Without the durable cursor it lands at v1 and is
+    invisible to them forever — the silent-loss scenario.)
+
+    Checkpointing is disabled for the restart, pinning the cold-open
+    path.  Since the dot-reuse fix (``Core._ensure_own_history``,
+    simulator-discovered: tests/data/sim/dot_reuse_crash_reopen.json),
+    the first write of a reopened producer auto-ingests its own durable
+    history first — deriving against an empty clock would re-mint
+    pre-crash event ids — so the increment CONTINUES from the resumed
+    state (15, not an absolute 10), identical to the warm-open twin
+    below."""
 
     async def go():
         local, remote = str(tmp_path / "l1"), str(tmp_path / "r")
@@ -123,15 +129,13 @@ def test_producer_cursor_survives_restart(tmp_path):
         )
         assert c1b.actor_id == actor
         await c1b.update(lambda s: s.inc(actor, 10))
+        # the write re-learned its own history (snapshot = 5) first
+        assert c1b.with_state(lambda s: s.read()) == 15
         # the op file must be at v3 — past the compacted v1..v2 range
         ops_dir = tmp_path / "r" / "ops" / actor.hex()
         assert sorted(p.name for p in ops_dir.iterdir()) == ["3"]
-        # the consumer's next scan finds it (G-Counter dot folds as max:
-        # the restarted producer derived from an empty state, so its dot is
-        # an absolute 10 — convergence, not 5+10; apps wanting true
-        # increments read_remote first, the documented resume protocol)
         await c2.read_remote()
-        assert c2.with_state(lambda s: s.read()) == 10
+        assert c2.with_state(lambda s: s.read()) == 15
 
     asyncio.run(go())
 

@@ -237,11 +237,15 @@ def test_crash_after_op_write_before_cursor_update(fs_factory, tmp_path):
 
 def test_restart_without_read_remote_probes_past_leaked_file(fs_factory, tmp_path):
     """Same fault as above, but the restarted replica writes immediately
-    (no read_remote): the durable cursor is stale, so the new op collides
-    with the leaked file and must probe forward to the next free version —
-    never clobber it.  (The written dot is derived from stale empty state,
-    so by G-Counter dot semantics the ops overlap and merge by max: a
-    reader converges to 7, the same value a host merge of both ops gives.)"""
+    (no explicit read_remote): the durable cursor never recorded the
+    leaked v1, so only storage can reveal it.  Since the dot-reuse fix
+    (``Core._ensure_own_history``, simulator-discovered:
+    tests/data/sim/dot_reuse_crash_reopen.json), the first write of an
+    incarnation probes its own op tail, finds the orphan, and ingests
+    it BEFORE deriving the new op — so the new op lands at v2 (never
+    clobbering v1), carries a fresh dot (no overlap with the leaked
+    op's), and the crashed increment survives: readers converge to
+    5 + 7 = 12, not to a max-masked 7."""
 
     async def go():
         local = str(tmp_path / "producer")
@@ -258,7 +262,8 @@ def test_restart_without_read_remote_probes_past_leaked_file(fs_factory, tmp_pat
         c2 = await Core.open(
             make_opts(FsStorage(local, remote), gcounter_adapter(), create=False)
         )
-        await c2.update(lambda s: s.inc(actor, 7))  # collides at v1 → probes to v2
+        await c2.update(lambda s: s.inc(actor, 7))  # own-tail probe found v1
+        assert c2.with_state(lambda s: s.read()) == 12
 
         # both op files exist: the leaked v1 was not clobbered
         dirty = FsStorage(str(tmp_path / "probe-local"), remote)
@@ -269,7 +274,7 @@ def test_restart_without_read_remote_probes_past_leaked_file(fs_factory, tmp_pat
             make_opts(FsStorage(str(tmp_path / "reader"), remote), gcounter_adapter())
         )
         await c3.read_remote()
-        assert c3.with_state(lambda s: s.read()) == 7
+        assert c3.with_state(lambda s: s.read()) == 12
 
     run(go())
 

@@ -1,0 +1,617 @@
+"""Fault-tolerance regressions the simulator's fault layer targets:
+
+* ingest quarantine — a torn/unauthenticated synced file (op or state)
+  is skipped with the ``ingest_quarantined`` counter bumped and the
+  cursor HELD, never an aborted read and never a cursor advanced past
+  damage, so a repaired sync retries it (ISSUE-9 satellite 1);
+* fs concurrent-GC tolerance — files and whole actor dirs disappearing
+  between list and load (a second Core's compaction) skip-and-resample
+  instead of raising mid-ingest (ISSUE-9 satellite 2);
+* the FaultyStorage wrapper itself — deterministic decisions, density
+  preserved under censoring, clean passthrough after heal().
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from crdt_enc_tpu.backends import (
+    FsStorage,
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+from crdt_enc_tpu.models import canonical_bytes
+from crdt_enc_tpu.utils import trace
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_opts(storage, *, create=True, accel=None, cryptor=None):
+    extra = {"accelerator": accel} if accel is not None else {}
+    return OpenOptions(
+        storage=storage,
+        cryptor=cryptor if cryptor is not None else IdentityCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=orset_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=create,
+        **extra,
+    )
+
+
+def _quarantined() -> int:
+    return int(trace.snapshot()["counters"].get("ingest_quarantined", 0))
+
+
+# ------------------------------------------------------ op quarantine
+@pytest.mark.parametrize("backend", ["memory", "fs"])
+def test_torn_op_blob_quarantined_then_retried(tmp_path, backend):
+    """A truncated op blob must not abort read_remote: the good prefix
+    folds, the damaged file quarantines (counter + held cursor), and a
+    repaired sync delivers the tail."""
+
+    async def go():
+        if backend == "memory":
+            remote = MemoryRemote()
+            sa, sb = MemoryStorage(remote), MemoryStorage(remote)
+        else:
+            sa = FsStorage(str(tmp_path / "a"), str(tmp_path / "remote"))
+            sb = FsStorage(str(tmp_path / "b"), str(tmp_path / "remote"))
+        a = await Core.open(make_opts(sa))
+        for i in range(3):
+            await a.update(lambda s, i=i: s.add_ctx(a.actor_id, f"m{i}"))
+        actor = a.actor_id
+
+        # tear v2 mid-transfer
+        if backend == "memory":
+            intact = remote.ops[actor][2]
+            remote.ops[actor][2] = intact[:5]
+        else:
+            path = os.path.join(
+                str(tmp_path / "remote"), "ops", actor.hex(), "2"
+            )
+            intact = open(path, "rb").read()
+            with open(path, "wb") as f:
+                f.write(intact[:5])
+
+        b = await Core.open(make_opts(sb))
+        q0 = _quarantined()
+        await b.read_remote()  # must NOT raise
+        assert _quarantined() > q0
+        # v1 folded, cursor held at the hole
+        assert b.info().next_op_versions.get(actor) == 1
+        assert b.with_state(lambda s: s.contains("m0"))
+        assert not b.with_state(lambda s: s.contains("m1"))
+
+        # the sync repairs the file: the retry ingests v2 and v3
+        if backend == "memory":
+            remote.ops[actor][2] = intact
+        else:
+            with open(path, "wb") as f:
+                f.write(intact)
+        await b.read_remote()
+        assert b.info().next_op_versions.get(actor) == 3
+        assert b.with_state(canonical_bytes) == a.with_state(canonical_bytes)
+
+    run(go())
+
+
+def test_torn_op_blob_quarantined_pipelined(tmp_path):
+    """The same discipline through the accelerated pipelined bulk
+    ingest (producer-side unwrap quarantine + chunk validation)."""
+    from crdt_enc_tpu.parallel import TpuAccelerator
+
+    async def go():
+        sa = FsStorage(str(tmp_path / "a"), str(tmp_path / "remote"))
+        sb = FsStorage(str(tmp_path / "b"), str(tmp_path / "remote"))
+        a = await Core.open(make_opts(sa))
+        for i in range(20):
+            await a.update(lambda s, i=i: s.add_ctx(a.actor_id, f"m{i}"))
+        actor = a.actor_id
+        path = os.path.join(
+            str(tmp_path / "remote"), "ops", actor.hex(), "10"
+        )
+        intact = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(intact[: len(intact) // 3])
+
+        b = await Core.open(
+            make_opts(sb, accel=TpuAccelerator(min_device_batch=1))
+        )
+        q0 = _quarantined()
+        await b.read_remote()
+        assert _quarantined() > q0
+        assert b.info().next_op_versions.get(actor) == 9
+        with open(path, "wb") as f:
+            f.write(intact)
+        await b.read_remote()
+        assert b.info().next_op_versions.get(actor) == 20
+        assert b.with_state(canonical_bytes) == a.with_state(canonical_bytes)
+
+    run(go())
+
+
+def test_torn_snapshot_quarantined_then_retried():
+    """A truncated state snapshot is skipped (NOT added to read_states)
+    and merged once the sync repairs it."""
+
+    async def go():
+        remote = MemoryRemote()
+        a = await Core.open(make_opts(MemoryStorage(remote)))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "x"))
+        await a.compact()
+        (name, intact), = list(remote.states.items())
+        remote.states[name] = intact[:7]
+
+        b = await Core.open(make_opts(MemoryStorage(remote)))
+        q0 = _quarantined()
+        await b.read_remote()
+        assert _quarantined() > q0
+        assert not b.with_state(lambda s: s.contains("x"))
+        assert name not in b.info().read_states
+
+        remote.states[name] = intact
+        await b.read_remote()
+        assert b.with_state(canonical_bytes) == a.with_state(canonical_bytes)
+
+    run(go())
+
+
+def test_tampered_aead_op_quarantined():
+    """With a real AEAD, a bit-flipped ciphertext fails authentication:
+    quarantined per file, never folded, never a cursor advance."""
+    pytest.importorskip("crdt_enc_tpu.backends.xchacha")
+    from crdt_enc_tpu import native
+    from crdt_enc_tpu.backends.xchacha import XChaChaCryptor
+
+    try:
+        native.load()
+    except Exception:
+        pytest.skip("native AEAD unavailable on this box")
+
+    async def go():
+        remote = MemoryRemote()
+        a = await Core.open(
+            make_opts(MemoryStorage(remote), cryptor=XChaChaCryptor())
+        )
+        await a.update(lambda s: s.add_ctx(a.actor_id, "good"))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "alsogood"))
+        actor = a.actor_id
+        blob = bytearray(remote.ops[actor][1])
+        blob[-1] ^= 1  # break the tag
+        remote.ops[actor][1] = bytes(blob)
+
+        b = await Core.open(
+            make_opts(MemoryStorage(remote), cryptor=XChaChaCryptor())
+        )
+        q0 = _quarantined()
+        await b.read_remote()
+        assert _quarantined() > q0
+        # v1 damaged: nothing folds (v2 is past the hole), cursor at 0
+        assert b.info().next_op_versions.get(actor) == 0
+        assert not b.with_state(lambda s: s.contains("good"))
+
+    run(go())
+
+
+def test_unknown_key_still_loud():
+    """Quarantine must NOT swallow MissingKeyError: ops sealed with a
+    key whose metadata has not synced abort the read loudly (the
+    pre-existing contract, re-pinned next to the quarantine paths)."""
+    from crdt_enc_tpu.core import MissingKeyError
+
+    async def go():
+        ra, rb = MemoryRemote(), MemoryRemote()
+        ca = await Core.open(make_opts(MemoryStorage(ra)))
+        cb = await Core.open(make_opts(MemoryStorage(rb)))
+        await cb.update(lambda s: s.add_ctx(cb.actor_id, "m"))
+        for actor, log in rb.ops.items():
+            ra.ops.setdefault(actor, {}).update(log)
+        with pytest.raises(MissingKeyError):
+            await ca.read_remote()
+
+    run(go())
+
+
+def test_service_quarantines_torn_tenant_file():
+    """A torn op file reaching the FoldService front end quarantines
+    instead of erroring the tenant cycle after cycle (the
+    torn_op_service_abort fixture's bug class, unit-pinned)."""
+    from crdt_enc_tpu.serve import FoldService, ServeConfig
+
+    async def go():
+        remote = MemoryRemote()
+        a = await Core.open(make_opts(MemoryStorage(remote)))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "k"))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "k2"))
+        actor = a.actor_id
+        intact = remote.ops[actor][1]
+        remote.ops[actor][1] = intact[:4]
+
+        b = await Core.open(make_opts(MemoryStorage(remote)))
+        service = FoldService([b], ServeConfig())
+        q0 = _quarantined()
+        (res,) = await service.run_cycle()
+        assert res.error is None, res.error
+        assert _quarantined() > q0
+        assert b.info().next_op_versions.get(actor) == 0  # cursor held
+
+        remote.ops[actor][1] = intact
+        (res,) = await service.run_cycle()
+        assert res.error is None
+        assert b.info().next_op_versions.get(actor) == 2
+        assert b.with_state(canonical_bytes) == a.with_state(canonical_bytes)
+
+    run(go())
+
+
+# ------------------------------------------------ writer dot-reuse guard
+def test_reopened_producer_relearns_own_history():
+    """The dot_reuse_crash_reopen fixture's bug class, unit-pinned: a
+    producer that crashes and writes again after a cold reopen must
+    NOT mint Orswot dots from its stale clock (they'd collide with its
+    pre-crash events and break convergence for every replica) — the
+    first write auto-ingests its own durable history instead."""
+
+    async def go():
+        remote = MemoryRemote()
+        storage = MemoryStorage(remote)
+        a = await Core.open(make_opts(storage))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "pre-crash"))
+        # crash: the Core object is dropped, storage survives
+        b = await Core.open(make_opts(storage, create=False))
+        await b.update(lambda s: s.add_ctx(b.actor_id, "post-reopen"))
+        # the write re-learned v1 first: both adds live, distinct dots
+        assert b.with_state(lambda s: s.contains("pre-crash"))
+        assert b.with_state(lambda s: s.contains("post-reopen"))
+        reader = await Core.open(make_opts(MemoryStorage(remote)))
+        await reader.read_remote()
+        assert reader.with_state(canonical_bytes) == b.with_state(
+            canonical_bytes
+        )
+
+    run(go())
+
+
+def test_reopened_producer_refuses_write_when_history_hidden():
+    """When the remote does not (yet) show the producer's own recorded
+    history — partial sync after a crash — the write is refused loudly
+    (StaleWriterError) instead of silently reusing event ids."""
+    from crdt_enc_tpu.core import StaleWriterError
+
+    class BlindStorage(MemoryStorage):
+        """A remote where this replica's own files have not synced
+        back: nothing is listed, nothing loads."""
+
+        async def list_op_actors(self):
+            return []
+
+        async def list_state_names(self):
+            return []
+
+        async def load_ops(self, wanted):
+            return []
+
+        async def stat_ops(self, wanted):
+            return []
+
+    async def go():
+        remote = MemoryRemote()
+        storage = MemoryStorage(remote)
+        a = await Core.open(make_opts(storage))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "pre-crash"))
+        blind = BlindStorage(remote)
+        blind._local_meta = storage._local_meta  # same replica identity
+        b = await Core.open(make_opts(blind, create=False))
+        with pytest.raises(StaleWriterError):
+            await b.update(lambda s: s.add_ctx(b.actor_id, "unsafe"))
+
+    run(go())
+
+
+# -------------------------------------------------- fs concurrent-GC races
+def test_fs_reader_survives_real_concurrent_gc(tmp_path):
+    """The satellite-2 race, deterministically interleaved: B lists the
+    remote, then a REAL second Core's compaction GCs those exact files
+    before B loads them.  B's ingest must skip-and-resample (missing =
+    already-covered), never raise, and converge on the next read."""
+
+    class RacingStorage(FsStorage):
+        """Runs a callback between list and load — the adversarial
+        interleaving made deterministic."""
+
+        race = None
+
+        async def load_states(self, names):
+            if RacingStorage.race is not None:
+                cb, RacingStorage.race = RacingStorage.race, None
+                await cb()
+            return await super().load_states(names)
+
+        async def load_ops(self, wanted):
+            if RacingStorage.race is not None:
+                cb, RacingStorage.race = RacingStorage.race, None
+                await cb()
+            return await super().load_ops(wanted)
+
+    async def go():
+        remote = str(tmp_path / "remote")
+        a = await Core.open(
+            make_opts(FsStorage(str(tmp_path / "a"), remote))
+        )
+        for i in range(3):
+            await a.update(lambda s, i=i: s.add_ctx(a.actor_id, f"m{i}"))
+        await a.compact()  # snapshot v1..v3 + op GC
+        await a.update(lambda s: s.add_ctx(a.actor_id, "tail"))
+
+        b = await Core.open(
+            make_opts(RacingStorage(str(tmp_path / "b"), remote))
+        )
+
+        async def gc():
+            # A compacts again: removes the snapshot B just listed and
+            # the op tail B is about to load
+            await a.compact()
+
+        RacingStorage.race = gc
+        await b.read_remote()  # must not raise
+        await b.read_remote()  # resample: the new snapshot covers it all
+        assert b.with_state(canonical_bytes) == a.with_state(canonical_bytes)
+
+    run(go())
+
+
+def test_fs_publish_survives_actor_dir_rmdir(tmp_path, monkeypatch):
+    """remove_ops rmdir's an emptied actor dir; a concurrent publisher
+    whose dir vanishes between makedirs and the tmp open must retry,
+    not surface FileNotFoundError (satellite 2, write side)."""
+    import shutil
+
+    from crdt_enc_tpu.backends import fs as fs_mod
+
+    d = str(tmp_path / "ops" / "aa")
+    target = os.path.join(d, "1")
+    real_write_tmp = fs_mod._write_tmp
+    calls = {"n": 0}
+
+    def racing_write_tmp(dd, data):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # the GC wins the race after our makedirs
+            shutil.rmtree(dd)
+            raise FileNotFoundError(dd)
+        return real_write_tmp(dd, data)
+
+    monkeypatch.setattr(fs_mod, "_write_tmp", racing_write_tmp)
+    fs_mod._write_file_new(target, b"payload")
+    assert open(target, "rb").read() == b"payload"
+    assert calls["n"] == 2
+
+
+def test_fs_publish_survives_vanishing_collider(tmp_path, monkeypatch):
+    """os.link says EEXIST but the collider is GC'd before the
+    idempotence check reads it: retry the link instead of raising
+    FileNotFoundError out of a content-addressed store."""
+    from crdt_enc_tpu.backends import fs as fs_mod
+
+    d = str(tmp_path / "states")
+    target = os.path.join(d, "HASH")
+    real_link = os.link
+    calls = {"n": 0}
+
+    def racing_link(src, dst, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # a concurrent writer's file existed at link time but was
+            # collected before our equality check could open it
+            raise FileExistsError(dst)
+        return real_link(src, dst, **kw)
+
+    monkeypatch.setattr(fs_mod.os, "link", racing_link)
+    fs_mod._write_file_new(target, b"blob")
+    assert open(target, "rb").read() == b"blob"
+    assert calls["n"] == 2
+
+
+def test_fs_op_publish_burns_vanished_collider_version(tmp_path, monkeypatch):
+    """Version-addressed op files must NOT relink after a vanished
+    collider: the collider existed (a peer may have folded it into a
+    snapshot), so republishing different content at that version would
+    be invisible to every cursor already past it.  The burned version
+    surfaces as FileExistsError and the producer probes forward."""
+    from crdt_enc_tpu.backends import fs as fs_mod
+
+    d = str(tmp_path / "ops" / "aa")
+    target = os.path.join(d, "1")
+    real_link = os.link
+    calls = {"n": 0}
+
+    def racing_link(src, dst, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FileExistsError(dst)  # collider present at link time...
+        return real_link(src, dst, **kw)  # ...but GC'd before the check
+
+    monkeypatch.setattr(fs_mod.os, "link", racing_link)
+    with pytest.raises(FileExistsError):
+        fs_mod._write_file_new(
+            target, b"new-content", relink_vanished_collider=False
+        )
+    assert not os.path.exists(target)  # nothing republished at v1
+
+
+def test_systemic_decrypt_failure_escalates_not_quarantines():
+    """Every file of a multi-file batch failing to decrypt is a dead
+    cryptor / damaged key register, not per-file damage: read_remote
+    must raise IngestDecryptError loudly instead of quarantining the
+    whole backlog into a silently-stuck replica."""
+    from crdt_enc_tpu.core import IngestDecryptError
+
+    async def go():
+        remote = MemoryRemote()
+        a = await Core.open(make_opts(MemoryStorage(remote)))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "x"))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "y"))
+
+        class DeadCryptor(IdentityCryptor):
+            async def decrypt(self, key, data):
+                raise RuntimeError("cryptor backend is broken")
+
+        b = await Core.open(
+            make_opts(MemoryStorage(remote), cryptor=DeadCryptor())
+        )
+        with pytest.raises(IngestDecryptError) as ei:
+            await b.read_remote()
+        assert "backend is broken" in repr(ei.value.__cause__)
+        # nothing advanced: the backlog is intact for after the repair
+        assert b.info().next_op_versions.get(a.actor_id) == 0
+
+    run(go())
+
+
+def test_own_tail_probe_failure_retries_next_write():
+    """The dot-reuse guard must not fail open permanently: a transient
+    stat_ops error on the first write leaves the incarnation's
+    own-history check unsatisfied, so the next write probes again."""
+
+    class FlakyStatStorage(MemoryStorage):
+        stat_calls = 0
+        fail_next = False
+
+        async def stat_ops(self, wanted):
+            type(self).stat_calls += 1
+            if type(self).fail_next:
+                type(self).fail_next = False
+                raise OSError("transient storage error")
+            return await super().stat_ops(wanted)
+
+    async def go():
+        remote = MemoryRemote()
+        storage = FlakyStatStorage(remote)
+        FlakyStatStorage.stat_calls = 0
+        c = await Core.open(make_opts(storage))
+        base = FlakyStatStorage.stat_calls  # open() samples replication
+        FlakyStatStorage.fail_next = True
+        await c.update(lambda s: s.add_ctx(c.actor_id, "m1"))  # probe fails
+        first = FlakyStatStorage.stat_calls
+        await c.update(lambda s: s.add_ctx(c.actor_id, "m2"))  # re-probes
+        second = FlakyStatStorage.stat_calls
+        assert first > base and second > first
+        await c.update(lambda s: s.add_ctx(c.actor_id, "m3"))  # now cached
+        assert FlakyStatStorage.stat_calls == second
+
+    run(go())
+
+
+# ------------------------------------------------- FaultyStorage itself
+def test_faulty_storage_deterministic_and_heals():
+    from crdt_enc_tpu.sim import FaultConfig, FaultyStorage
+
+    async def go():
+        remote = MemoryRemote()
+        writer = MemoryStorage(remote)
+        actor = b"\x01" * 16
+        for v in range(1, 9):
+            await writer.store_ops(actor, v, f"payload-{v}".encode() * 4)
+
+        def wrap():
+            return FaultyStorage(
+                MemoryStorage(remote),
+                FaultConfig(torn_read=0.5, partial_list=0.3),
+                seed=7, name="r0",
+            )
+
+        async def observe(w):
+            out = []
+            for _ in range(4):
+                out.append(await w.load_ops([(actor, 1)]))
+                out.append(await w.list_op_actors())
+            return out
+
+        a = await observe(wrap())
+        b = await observe(wrap())
+        assert a == b  # pure function of (seed, call sequence)
+        w = wrap()
+        assert w.stats.total() == 0 or True
+        w.heal()
+        clean = await w.load_ops([(actor, 1)])
+        assert clean == await writer.load_ops([(actor, 1)])
+
+    run(go())
+
+
+def test_faulty_storage_censor_preserves_density():
+    """Delayed visibility may hide op files, but whatever is delivered
+    stays a gap-free per-actor prefix — the storage contract the core's
+    dense scan depends on — and ticks eventually reveal everything."""
+    from crdt_enc_tpu.sim import FaultConfig, FaultyStorage
+
+    async def go():
+        remote = MemoryRemote()
+        writer = MemoryStorage(remote)
+        actors = [b"\x01" * 16, b"\x02" * 16]
+        for actor in actors:
+            for v in range(1, 6):
+                await writer.store_ops(actor, v, b"x" * 8)
+        w = FaultyStorage(
+            MemoryStorage(remote),
+            FaultConfig(delay_visibility=0.9, delay_max_ticks=2),
+            seed=3, name="r1",
+        )
+        for round_ in range(6):
+            files = await w.load_ops([(a, 1) for a in actors])
+            per_actor: dict = {}
+            for actor, version, _ in files:
+                per_actor.setdefault(actor, []).append(version)
+            for actor, versions in per_actor.items():
+                assert versions == list(range(1, len(versions) + 1)), (
+                    round_, versions,
+                )
+            w.tick()
+        # all reveal delays expired by now
+        files = await w.load_ops([(a, 1) for a in actors])
+        assert len(files) == 10
+
+    run(go())
+
+
+def test_faulty_storage_write_crash_before_or_after():
+    """SimCrash fires on write steps; crash-AFTER leaves the write
+    durable, crash-BEFORE leaves nothing — both must occur across a
+    seed sweep (the adversary genuinely explores both worlds).  A
+    landed crash-AFTER write must still register as the replica's OWN
+    (immediately visible even under max visibility delay): the wrapper
+    models a crashed process, not a replica blind to its own durable
+    files."""
+    from crdt_enc_tpu.sim import FaultConfig, FaultyStorage, SimCrash
+
+    async def go():
+        before = after = 0
+        actor = b"\x03" * 16
+        for seed in range(40):
+            remote = MemoryRemote()
+            w = FaultyStorage(
+                MemoryStorage(remote),
+                FaultConfig(write_crash=1.0, delay_visibility=1.0),
+                seed=seed, name="r0",
+            )
+            with pytest.raises(SimCrash):
+                await w.store_ops(actor, 1, b"data")
+            if remote.ops:
+                after += 1
+                w.cfg = FaultConfig(delay_visibility=1.0)  # crashes off
+                files = await w.load_ops([(actor, 1)])
+                assert [v for _, v, _ in files] == [1], "own write hidden"
+            else:
+                before += 1
+        assert before > 0 and after > 0
+
+    run(go())

@@ -19,6 +19,14 @@ python tools/check_span_names.py
 echo "== thread-discipline shim =="
 python tools/check_thread_discipline.py
 
+echo "== adversarial sim smoke (bounded) + fixture replay =="
+# one all-faults schedule with the full invariant check (~5s incl. jax
+# import), then every committed shrunk-failure fixture — a regressed
+# fixture fails the build (docs/simulation.md)
+JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
+    --seed 0 --replicas 4 --steps 80 --faults all
+JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim replay tests/data/sim
+
 echo "== obs_report fleet golden =="
 python -m crdt_enc_tpu.tools.obs_report fleet \
     tests/data/fleet_device_a.jsonl tests/data/fleet_device_b.jsonl \
