@@ -1165,6 +1165,272 @@ def _flag_int(flag: str, default: int) -> int:
     return default
 
 
+class _CountingStorage:
+    """Wrap a Storage, counting every remote payload byte the core
+    reads (states + op files + deltas) — the e2e-delta bench's
+    measurement instrument.  Everything else forwards untouched."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.bytes_read = 0
+        self.files_read = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _count(self, loaded):
+        for item in loaded:
+            self.bytes_read += len(item[-1])
+            self.files_read += 1
+        return loaded
+
+    async def load_states(self, names):
+        return self._count(await self._inner.load_states(names))
+
+    async def load_ops(self, wanted):
+        return self._count(await self._inner.load_ops(wanted))
+
+    async def load_deltas(self, wanted):
+        return self._count(await self._inner.load_deltas(wanted))
+
+    async def iter_op_chunks(self, wanted, max_bytes=None):
+        kw = {} if max_bytes is None else {"max_bytes": max_bytes}
+        async for chunk in self._inner.iter_op_chunks(wanted, **kw):
+            yield self._count(chunk)
+
+
+def e2e_delta(smoke: bool):
+    """ISSUE-10 acceptance: remote bytes read by an INCREMENTAL consumer
+    — delta-chain path vs full-snapshot path — on the same remote.
+
+    One producer builds a real three-layer-sealed FS remote, folds it,
+    and compacts (snapshot + delta per round, docs/delta.md).  Two
+    consumers track it: A with delta-state replication on (folds
+    ``known-base + delta chain``), B with it off (re-downloads the full
+    snapshot every round).  Each round lands a ~BENCH_DELTA_TAIL_PCT%
+    op tail before the producer compacts again.  The record is the
+    bytes-read reduction A/B plus wall times; byte-identity of all
+    three states is ASSERTED and the run refuses to record otherwise
+    (the divergence guard every e2e bench carries).
+
+    Env knobs: BENCH_DELTA_OPS (200_000), BENCH_DELTA_REPLICAS (2_000),
+    BENCH_DELTA_MEMBERS (512), BENCH_DELTA_OPF (48, ops/file),
+    BENCH_DELTA_ROUNDS (5), BENCH_DELTA_TAIL_PCT (1.0).
+    """
+    import asyncio
+    import tempfile
+
+    N = int(os.environ.get("BENCH_DELTA_OPS", 6_000 if smoke else 200_000))
+    R = int(os.environ.get("BENCH_DELTA_REPLICAS", 60 if smoke else 2_000))
+    E = int(os.environ.get("BENCH_DELTA_MEMBERS", 64 if smoke else 512))
+    OPF = int(os.environ.get("BENCH_DELTA_OPF", 48))
+    ROUNDS = int(os.environ.get("BENCH_DELTA_ROUNDS", 2 if smoke else 5))
+    TAIL_PCT = float(os.environ.get("BENCH_DELTA_TAIL_PCT", 1.0))
+
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    first_platform = platforms.split(",")[0].strip() if platforms else ""
+    want_tpu = first_platform not in ("cpu",) and not smoke
+    jax, dev = acquire_jax(want_tpu)
+
+    from benchmarks.suite import actor_bytes_table
+    from crdt_enc_tpu.backends import (
+        FsStorage, PlainKeyCryptor, XChaChaCryptor,
+    )
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.models import canonical_bytes
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.utils import trace
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    def opts(storage, create, delta=True):
+        return OpenOptions(
+            storage=storage,
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=create,
+            accelerator=TpuAccelerator(),
+            delta=delta,
+        )
+
+    kind, member, actor, counter = gen_columns(N, R, E, seed=23)
+    actors = actor_bytes_table(R)
+    live = actor < R
+    order = np.argsort(actor[live], kind="stable")
+    k_l = kind[live][order]
+    m_l = member[live][order]
+    a_l = actor[live][order]
+    c_l = counter[live][order]
+
+    def file_payloads():
+        i, n = 0, len(k_l)
+        versions: dict = {}
+        while i < n:
+            j = min(i + OPF, n)
+            j = i + int(np.searchsorted(a_l[i:j], a_l[i], side="right"))
+            ab = actors[int(a_l[i])]
+            ops = []
+            for t in range(i, j):
+                if k_l[t] == 0:
+                    ops.append([0, int(m_l[t]), [ab, int(c_l[t])]])
+                else:
+                    ops.append([1, int(m_l[t]), {ab: int(c_l[t])}])
+            v = versions.get(ab, 0) + 1
+            versions[ab] = v
+            yield ab, v, ops
+            i = j
+        # the per-round incremental tails continue each actor's log
+        while True:
+            target = max(1, int(N * TAIL_PCT / 100.0))
+            got = 0
+            batch = []
+            for ab in actors:
+                if got >= target:
+                    break
+                v = versions.get(ab, 0) + 1
+                versions[ab] = v
+                ops = [
+                    [0, int((v * 37 + t) % E), [ab, 1_000_000 + v * OPF + t]]
+                    for t in range(min(OPF, target - got))
+                ]
+                got += len(ops)
+                batch.append((ab, v, ops))
+            yield ("round", batch)
+
+    gen = file_payloads()
+    prefix = []
+    for item in gen:
+        if isinstance(item[0], str):
+            break
+        prefix.append(item)
+
+    tmp = tempfile.mkdtemp(prefix="crdt-e2e-delta-")
+    remote = os.path.join(tmp, "remote")
+    log(
+        f"e2e_delta: device {dev.platform}; {len(prefix)} files, {N} ops, "
+        f"R={R} E={E} rounds={ROUNDS} tail={TAIL_PCT:g}% remote={remote}"
+    )
+
+    async def build_and_measure():
+        producer = await Core.open(
+            opts(FsStorage(os.path.join(tmp, "localP"), remote), create=True)
+        )
+
+        async def store_files(batch):
+            sem = asyncio.Semaphore(64)
+
+            async def one(ab, v, ops):
+                async with sem:
+                    blob = await producer._seal(ops)
+                    await producer.storage.store_ops(ab, v, blob)
+
+            await asyncio.gather(*(one(*f) for f in batch))
+
+        t0 = time.perf_counter()
+        CHUNK = 2048
+        for i in range(0, len(prefix), CHUNK):
+            await store_files(prefix[i : i + CHUNK])
+        t_build = time.perf_counter() - t0
+        await producer.compact()
+        log(f"remote built + first compact: {t_build:.1f}s")
+
+        storage_a = _CountingStorage(
+            FsStorage(os.path.join(tmp, "localA"), remote)
+        )
+        storage_b = _CountingStorage(
+            FsStorage(os.path.join(tmp, "localB"), remote)
+        )
+        c_delta = await Core.open(opts(storage_a, create=True))
+        c_snap = await Core.open(opts(storage_b, create=True, delta=False))
+        await c_delta.read_remote()
+        await c_snap.read_remote()
+        # the incremental phase is the measurement window
+        storage_a.bytes_read = storage_a.files_read = 0
+        storage_b.bytes_read = storage_b.files_read = 0
+        trace.reset()
+        t_delta = t_snap = 0.0
+        for _ in range(ROUNDS):
+            tag, batch = next(gen)
+            assert tag == "round"
+            await store_files(batch)
+            await producer.compact()
+            t0 = time.perf_counter()
+            await c_delta.read_remote()
+            t_delta += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            await c_snap.read_remote()
+            t_snap += time.perf_counter() - t0
+        obs = trace.snapshot()
+        pa = producer.with_state(canonical_bytes)
+        equal = (
+            c_delta.with_state(canonical_bytes) == pa
+            and c_snap.with_state(canonical_bytes) == pa
+        )
+        return (
+            t_build, t_delta, t_snap, equal,
+            storage_a.bytes_read, storage_b.bytes_read,
+            storage_a.files_read, storage_b.files_read, obs,
+        )
+
+    (t_build, t_delta, t_snap, equal, bytes_delta, bytes_snap,
+     files_delta, files_snap, obs) = asyncio.run(build_and_measure())
+
+    counters = obs.get("counters", {})
+    applied = counters.get("delta_applied", 0)
+    reduction = bytes_snap / bytes_delta if bytes_delta else float("inf")
+    log(
+        f"incremental consumer over {ROUNDS} rounds: delta path "
+        f"{bytes_delta}B / snapshot path {bytes_snap}B → {reduction:.1f}x "
+        f"fewer remote bytes (chains applied: {applied}; "
+        f"wall {t_delta:.2f}s vs {t_snap:.2f}s)"
+    )
+    result = {
+        "metric": "orset_e2e_delta_bytes_reduction",
+        "config": f"delta_{N}ops_{R}r_{ROUNDS}x{TAIL_PCT:g}pct_tail",
+        "value": round(reduction, 2),
+        "unit": "x",
+        "bytes_read_delta_path": int(bytes_delta),
+        "bytes_read_snapshot_path": int(bytes_snap),
+        "files_read_delta_path": int(files_delta),
+        "files_read_snapshot_path": int(files_snap),
+        "read_wall_delta_s": round(t_delta, 4),
+        "read_wall_snapshot_s": round(t_snap, 4),
+        "build_s": round(t_build, 1),
+        "deltas_applied": int(applied),
+        "deltas_sealed": int(counters.get("delta_files_sealed", 0)),
+        "delta_bytes_sealed": int(counters.get("delta_bytes_sealed", 0)),
+        "delta_fallbacks": int(counters.get("delta_fallbacks", 0)),
+        "byte_identical": bool(equal),
+        "backend": dev.platform,
+    }
+    print(json.dumps(result))
+    # the divergence guard: a run whose delta path did not converge
+    # byte-identically (or never used the chain) proves nothing and
+    # must not become perf evidence
+    if not equal or applied < ROUNDS:
+        log(
+            f"FAILED: byte_identical={equal} chains_applied={applied}/"
+            f"{ROUNDS} — refusing to record"
+        )
+        raise SystemExit(1)
+    if os.environ.get("BENCH_LOCAL_DISABLE") == "1":
+        return
+    if dev.platform != "tpu" and os.environ.get("BENCH_LOCAL_ALL") != "1":
+        return
+    _append_local({
+        **result,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "device_kind": dev.device_kind,
+        "host_cpus": os.cpu_count(),
+        "shape": {"N": N, "R": R, "E": E, "ops_per_file": OPF,
+                  "rounds": ROUNDS, "tail_pct": TAIL_PCT},
+        "obs": obs,
+    })
+
+
 def bench_sim(smoke: bool):
     """Adversarial-simulator throughput (docs/simulation.md): schedules
     per second over seeded all-fault runs — the explorable-schedule
@@ -1251,6 +1517,9 @@ def main():
     smoke = "--smoke" in sys.argv
     if "--sim" in sys.argv:
         bench_sim(smoke)
+        return
+    if "--e2e-delta" in sys.argv:
+        e2e_delta(smoke)
         return
     if "--e2e-streaming" in sys.argv:
         e2e_streaming(smoke)

@@ -179,6 +179,10 @@ class FsStorage(Storage):
         base = os.path.join(self.remote, "ops")
         return os.path.join(base, actor.hex()) if actor is not None else base
 
+    def _deltas_dir(self, actor: Actor | None = None) -> str:
+        base = os.path.join(self.remote, "deltas")
+        return os.path.join(base, actor.hex()) if actor is not None else base
+
     # -- local meta --------------------------------------------------------
     async def load_local_meta(self) -> bytes | None:
         return await self._run(_read_file, self._local_meta_path())
@@ -650,3 +654,76 @@ class FsStorage(Storage):
                 pass
 
         await asyncio.gather(*(self._run(rm, a, last) for a, last in actor_last_versions))
+
+    # -- delta snapshots ---------------------------------------------------
+    # Same layout idiom as the op logs (``remote/deltas/<actor-hex>/<N>``)
+    # but a simpler read contract: logs are MAX_CHAIN-bounded and files
+    # are deltas (small by construction), so a plain listdir+read per
+    # actor is the whole fast path — no native scan, no probe prefilter.
+    has_deltas = True
+
+    async def list_delta_actors(self) -> list[Actor]:
+        names = await self._run(_list_dir, self._deltas_dir())
+        actors = []
+        for n in names:
+            try:
+                actors.append(bytes.fromhex(n))
+            except ValueError:
+                continue  # foreign junk in the synced dir is not ours to judge
+        return sorted(a for a in actors if len(a) == 16)
+
+    async def load_deltas(
+        self, actor_first_versions: list[tuple[Actor, int]]
+    ) -> list[tuple[Actor, int, bytes]]:
+        def scan(actor: Actor, first: int) -> list[tuple[Actor, int, bytes]]:
+            d = self._deltas_dir(actor)
+            versions = sorted(
+                v for v in (
+                    int(n) for n in _list_dir(d) if n.isdigit()
+                ) if v >= first
+            )
+            out = []
+            for v in versions:
+                raw = _read_file(os.path.join(d, str(v)))
+                if raw is not None:  # racing GC may collect mid-walk
+                    out.append((actor, v, raw))
+            return out
+
+        per_actor = await asyncio.gather(
+            *(self._run(scan, a, f) for a, f in actor_first_versions)
+        )
+        return [item for chunk in per_actor for item in chunk]
+
+    async def store_delta(self, actor: Actor, version: int, data: bytes) -> None:
+        import functools
+
+        path = os.path.join(self._deltas_dir(actor), str(version))
+        # version-addressed like op files: a vanished collider burns the
+        # version (the producer probes forward) — _write_file_new's contract
+        await self._run(
+            functools.partial(
+                _write_file_new, path, bytes(data),
+                relink_vanished_collider=False,
+            )
+        )
+
+    async def remove_deltas(
+        self, actor_last_versions: list[tuple[Actor, int]]
+    ) -> None:
+        def rm(actor: Actor, last: int) -> None:
+            d = self._deltas_dir(actor)
+            for n in _list_dir(d):
+                try:
+                    v = int(n)
+                except ValueError:
+                    continue
+                if v <= last:
+                    _remove_quiet(os.path.join(d, n))
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass
+
+        await asyncio.gather(
+            *(self._run(rm, a, last) for a, last in actor_last_versions)
+        )

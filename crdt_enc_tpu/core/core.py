@@ -123,13 +123,32 @@ class LocalMeta:
 
     local_actor_id: bytes
     last_op_version: int = 0
+    # highest delta-snapshot version this replica ever sealed (the delta
+    # log is version-addressed like the op log, docs/delta.md); absent
+    # in pre-delta metas, so readers default to 0
+    last_delta_version: int = 0
+    # highest keys-ORSet dot counter this replica ever minted — the
+    # durable cursor behind the key-register dot-reuse guard in
+    # _install_new_key (simulator-discovered, same class as the op-log
+    # dot reuse: tests/data/sim/key_dot_reuse_partial_meta.json)
+    last_key_dot: int = 0
 
     def to_obj(self):
-        return {b"actor": self.local_actor_id, b"last_op": self.last_op_version}
+        return {
+            b"actor": self.local_actor_id,
+            b"last_op": self.last_op_version,
+            b"last_delta": self.last_delta_version,
+            b"last_key": self.last_key_dot,
+        }
 
     @classmethod
     def from_obj(cls, obj) -> "LocalMeta":
-        return cls(bytes(obj[b"actor"]), int(obj.get(b"last_op", 0)))
+        return cls(
+            bytes(obj[b"actor"]),
+            int(obj.get(b"last_op", 0)),
+            int(obj.get(b"last_delta", 0)),
+            int(obj.get(b"last_key", 0)),
+        )
 
 
 @dataclass
@@ -235,6 +254,13 @@ class OpenOptions:
     # read_remote() — for pure-consumer replicas that never compact.
     checkpoint: bool = True
     checkpoint_on_read: bool = False
+    # delta-state replication (docs/delta.md): with ``delta`` on and a
+    # storage backend that has the delta family, compact() additionally
+    # seals a delta snapshot since this replica's previous snapshot, and
+    # read_remote() prefers folding ``known-base + delta chain`` over
+    # re-reading full snapshots (automatic traced fallback on any gap,
+    # GC'd link, or fingerprint doubt).  ``CRDT_DELTA=0`` force-disables.
+    delta: bool = True
 
 
 async def open_sealed_blob(
@@ -293,6 +319,10 @@ class _MutData:
         # carries (obs/replication.py).  Monotone (clocks only merge) and
         # purely observational — convergence never depends on it.
         self.cursor_matrix: dict[Actor, VClock] = {}
+        # delta-chain consumption cursor: per sealer, the highest delta
+        # version already scanned (applied OR skipped) — the next read
+        # loads only past it, and compaction GCs the consumed prefix
+        self.read_deltas: dict[Actor, int] = {}
 
 
 class Core:
@@ -331,6 +361,17 @@ class Core:
         self.last_replication_status: dict | None = None
         # memoized _remote_id; dropped by every remote-meta merge site
         self._remote_id_cache: bytes | None = None
+        # delta-state replication (docs/delta.md): the retained base —
+        # the last snapshot THIS replica sealed, as its canonical packed
+        # state bytes + name + cursor obj — is what the next compaction
+        # diffs against.  Bytes, not a live object: snapshot objs may
+        # alias mutable state dicts (the serve path's plane writeback).
+        self._delta_enabled = (
+            opts.delta and os.environ.get("CRDT_DELTA", "") != "0"
+        )
+        self._delta_verify = os.environ.get("CRDT_DELTA_VERIFY", "") != "0"
+        self._delta_base: dict | None = None
+        self.last_delta_fallback_reason: str | None = None
         # writer-side dot-reuse guard (_ensure_own_history): the first
         # write of this incarnation probes for un-refolded own history
         self._own_history_checked = False
@@ -475,13 +516,57 @@ class Core:
         """Generate a key, add it to the Keys CRDT as the new latest, and
         push through the key cryptor — the snapshot→write cycle runs under
         ``_keys_lock`` so concurrent meta ingestion cannot be superseded
-        by a stale snapshot."""
-        async with self._keys_lock:
-            material = await self.cryptor.gen_key()
-            keys = Keys.from_obj(self._data.keys.to_obj())
-            key = Key.new(material)
-            keys.insert_latest_key(self.actor_id, key)
-            await self.key_cryptor.set_keys(keys)
+        by a stale snapshot.
+
+        Key-register dot-reuse guard (simulator-discovered; shrunk repro
+        ``tests/data/sim/key_dot_reuse_partial_meta.json``): a reopened
+        replica whose own key-register write is not visible (a partially
+        synced meta listing) would mint a keys-ORSet dot its pre-crash
+        incarnation already spent on a DIFFERENT key — on merge the
+        Orswot kills one of the two entries, losing key material, and
+        when the latest-register tie-break lands on the killed id every
+        subsequent open dies with ``DanglingLatestKey``.  The durable
+        ``LocalMeta.last_key_dot`` cursor refuses the mint loudly
+        (:class:`MissingKeyError`, retry after sync) whenever the
+        observed keys clock trails it — the op-log
+        :meth:`_ensure_own_history` discipline applied to the key
+        register.  The cursor is persisted BEFORE the remote write, so
+        no crash window can mint a colliding dot; the cost is that a
+        crash between the two writes leaves a mint the cursor records
+        but the remote never saw — that replica refuses further mints
+        (rotation/bootstrap) until an operator intervenes, which is the
+        safe side: a refused rotation is recoverable, fleet-wide key
+        loss is not."""
+        for attempt in (0, 1):
+            async with self._keys_lock:
+                keys = Keys.from_obj(self._data.keys.to_obj())
+                expected = keys.keys.clock.get(self.actor_id) + 1
+                lm = self._local_meta
+                stale = lm is not None and expected <= lm.last_key_dot
+                if not stale:
+                    material = await self.cryptor.gen_key()
+                    key = Key.new(material)
+                    keys.insert_latest_key(self.actor_id, key)
+                    if lm is not None and expected > lm.last_key_dot:
+                        lm.last_key_dot = expected
+                        vb = VersionBytes(
+                            CURRENT_CONTAINER_VERSION,
+                            codec.pack(lm.to_obj()),
+                        )
+                        await self.storage.store_local_meta(vb.serialize())
+                    await self.key_cryptor.set_keys(keys)
+            if not stale:
+                break
+            if attempt == 0:
+                # our own register may simply not have been read yet
+                # this incarnation — one refresh before refusing
+                await self._read_remote_meta()
+                continue
+            raise MissingKeyError(
+                "own key-register history (keys dot "
+                f"{self._local_meta.last_key_dot}) is not yet visible on "
+                "the remote; minting now would reuse a spent key dot"
+            )
         if self._data.keys.get_key(key.id) is None:
             raise MissingKeyError("key cryptor did not install the new key")
         return key
@@ -552,7 +637,9 @@ class Core:
     def _unpack_checkpoint_state(self, fmt: int, st):
         return unpack_checkpoint_state(self.adapter, fmt, st)
 
-    async def save_checkpoint(self, *, _packed: tuple | None = None) -> bool:
+    async def save_checkpoint(
+        self, *, _packed: tuple | None = None, _snap: tuple | None = None
+    ) -> bool:
         """Seal the materialized state + ingest cursor + read-states set
         as this replica's local warm-open checkpoint (sealed with the
         normal data-key cryptor, stored through the storage port's
@@ -567,7 +654,16 @@ class Core:
         planes it already holds (no sparse walk), and the epoch guards
         staleness — if the state mutated since packing (a concurrent
         apply), the live state is re-packed here instead, so the sealed
-        (state, cursor) pair can never tear."""
+        (state, cursor) pair can never tear.
+
+        ``_snap`` is ``(snapshot_name, mut_epoch)`` from the compaction
+        seal tail: when the live state PROVABLY still equals the just-
+        sealed snapshot (same mutation epoch), the checkpoint records
+        the snapshot's name (``b"snap"``), so a warm reopen can restore
+        the delta-sealing base and keep its delta chain unbroken
+        (docs/delta.md).  States without a mutation epoch never record
+        it — a wrong base would seal wrong deltas, a missing one only
+        costs consumers one full snapshot read."""
         if not self._checkpoint_enabled:
             return False
         with trace.span("checkpoint.save"):
@@ -597,7 +693,17 @@ class Core:
                 b"cm": {
                     a: c.to_obj() for a, c in sorted(d.cursor_matrix.items())
                 },
+                # delta-chain continuity (both observational): the
+                # per-sealer delta consumption cursor, and — only when
+                # the epoch proves state == sealed snapshot — its name
+                b"rd": dict(sorted(d.read_deltas.items())),
             }
+            if (
+                _snap is not None
+                and _snap[1] is not None
+                and _snap[1] == getattr(d.state, "_mut", None)
+            ):
+                payload[b"snap"] = _snap[0].encode()
             blob = await self._seal(payload)
             await self.storage.store_local_checkpoint(blob)
             self._checkpoint_sig = sig  # only a DURABLE seal gates skips
@@ -648,6 +754,10 @@ class Core:
                         bytes(a): VClock.from_obj(c)
                         for a, c in (obj.get(b"cm") or {}).items()
                     }
+                    read_deltas = {
+                        bytes(a): int(v)
+                        for a, v in (obj.get(b"rd") or {}).items()
+                    }
                 except Exception:
                     logger.debug("checkpoint malformed", exc_info=True)
                     return await self._checkpoint_fallback("malformed")
@@ -690,11 +800,28 @@ class Core:
             d.next_op_versions = cursor
             d.read_states = read_states
             d.cursor_matrix = cursor_matrix
+            d.read_deltas = read_deltas
             # the installed resume point IS the last sealed one: a quiet
             # first poll under checkpoint_on_read must not reseal it
             self._checkpoint_sig = (
                 dict(cursor.counters), frozenset(read_states)
             )
+            # delta-base continuity: when the checkpoint proves it was
+            # sealed WITH the snapshot (state == snapshot, name known),
+            # the next compaction keeps extending the delta chain
+            # instead of breaking it with a delta-less seal
+            snap = obj.get(b"snap")
+            if (
+                self._delta_enabled
+                and isinstance(snap, (bytes, bytearray, memoryview))
+            ):
+                snap_name = bytes(snap).decode()
+                if snap_name in read_states:
+                    self._set_delta_base(
+                        snap_name,
+                        codec.pack(self.adapter.state_to_obj(state)),
+                        cursor.to_obj(),
+                    )
         self.opened_from_checkpoint = True
         return True
 
@@ -919,7 +1046,19 @@ class Core:
             names = await self.storage.list_state_names()
         new = [n for n in names if n not in self._data.read_states]
         if not new:
+            # a quiet poll pays NO delta machinery: deltas are sealed
+            # with their snapshots, so no unread snapshot ⇒ no new delta
             return
+        if self._delta_enabled and getattr(self.storage, "has_deltas", False):
+            # delta-first: chains that anchor at an already-merged base
+            # snapshot fold without downloading the full snapshot; any
+            # snapshot a chain cannot reach (gap, GC'd link, fingerprint
+            # doubt, no codec) is full-loaded below — the delta layer
+            # can save bytes but never lose data (docs/delta.md)
+            if await self._read_remote_deltas():
+                new = [n for n in new if n not in self._data.read_states]
+                if not new:
+                    return
         with trace.span("states.load"):
             loaded = await self.storage.load_states(new)
         sem = asyncio.Semaphore(IO_CONCURRENCY)
@@ -981,6 +1120,93 @@ class Core:
                     sealer, VClock()
                 ).merge(sw.next_op_versions)
         self._data.read_states.update(name for name, _, _ in decoded)
+
+    # ------------------------------------------------------- delta chains
+    def _delta_fallback(self, actor: Actor, version: int, reason: str) -> None:
+        """One unusable delta link: counted (``delta_fallbacks``) and
+        attributed, never silent — the snapshot path picks the slack up
+        in the same pass, so this is an efficiency signal, not an
+        error.  The last reason is kept for tests/operators."""
+        trace.add("delta_fallbacks", 1)
+        self.last_delta_fallback_reason = reason
+        logger.debug(
+            "delta chain fallback at %s:v%d (%s); using the snapshot path",
+            actor.hex(), version, reason,
+        )
+
+    async def _read_remote_deltas(self) -> int:
+        """Walk every sealer's delta log past the consumed cursor and
+        apply each link whose base snapshot this replica has already
+        merged (base NAME ∈ ``read_states`` — the content address is
+        the fingerprint, so an unknown or renamed base is doubt and
+        falls back).  Applying a link is byte-equal to merging its
+        target snapshot (delta/codec.py contract), so the target name
+        is marked read, its cursor merged, and the sealer's
+        cursor-matrix row advanced — exactly the full-snapshot
+        bookkeeping.  Returns the number of links applied."""
+        from ..delta import codec_for, wire
+
+        d = self._data
+        codec_cls = codec_for(self.adapter.name)
+        with trace.span("delta.read"):
+            actors = await self.storage.list_delta_actors()
+            wanted = [
+                (a, d.read_deltas.get(a, 0) + 1) for a in sorted(actors)
+            ]
+            if not wanted:
+                return 0
+            files = await self.storage.load_deltas(wanted)
+            if not files:
+                return 0
+            trace.add("delta_bytes_read", sum(len(raw) for _, _, raw in files))
+            applied = 0
+            chain = 0  # longest contiguous applied run this pass
+            run: dict[Actor, int] = {}
+            for actor, version, raw in files:
+                # scanned-is-consumed: whatever this link's fate, the next
+                # poll starts past it (its target is reachable through the
+                # snapshot listing regardless — see the caller's note)
+                if version > d.read_deltas.get(actor, 0):
+                    d.read_deltas[actor] = version
+                try:
+                    obj = await self._open_sealed(raw)
+                    rec = wire.parse_delta_obj(obj)
+                except MissingKeyError:
+                    # unlike op ingest this is NOT loud: the full
+                    # snapshot (sealed with the same key register) will
+                    # raise it if the key truly has not synced
+                    self._delta_fallback(actor, version, "unknown_key")
+                    continue
+                except Exception:
+                    logger.debug("delta undecodable", exc_info=True)
+                    self._delta_fallback(actor, version, "unreadable")
+                    continue
+                if rec.adapter != self.adapter.name:
+                    self._delta_fallback(actor, version, "adapter")
+                    continue
+                if rec.new_name in d.read_states:
+                    continue  # already merged (idempotent re-delivery)
+                if codec_cls is None:
+                    self._delta_fallback(actor, version, "no_codec")
+                    continue
+                if not rec.base_name or rec.base_name not in d.read_states:
+                    self._delta_fallback(actor, version, "base_missing")
+                    continue
+                # sync section: fold the link + full snapshot bookkeeping
+                codec_cls.apply(d.state, rec.delta_obj)
+                d.next_op_versions.merge(rec.new_cursor)
+                d.read_states.add(rec.new_name)
+                if rec.sealer != self.actor_id:
+                    d.cursor_matrix.setdefault(
+                        rec.sealer, VClock()
+                    ).merge(rec.new_cursor)
+                applied += 1
+                run[actor] = run.get(actor, 0) + 1
+                chain = max(chain, run[actor])
+            if applied:
+                trace.add("delta_applied", applied)
+                trace.gauge("delta_chain_length", chain)
+        return applied
 
     async def _read_remote_ops(self) -> None:
         with trace.span("ops.list"):
@@ -1568,6 +1794,178 @@ class Core:
         files, groups = self._unwrap_op_files(files)
         return actors, files, groups
 
+    # --------------------------------------------------------- delta sealing
+    def _plan_delta_seal(self, state_obj, cursor_obj):
+        """Sync section of the delta seal (docs/delta.md): diff the
+        about-to-be-sealed state against the retained base (this
+        replica's previous snapshot), self-verify, and hand the await
+        half (:meth:`_seal_delta`) an immutable plan.  Runs BEFORE the
+        first await of the seal tail so a concurrent apply cannot tear
+        the (base, new, delta) triple.
+
+        The plan always carries ``new_bytes`` — the canonical packed
+        state — which becomes the NEXT base even when no delta can be
+        cut this round (first seal, no codec, divergent or oversize
+        diff); ``dobj`` is None in those cases and consumers fall back
+        to the full snapshot for this link only."""
+        if not self._delta_enabled or not getattr(
+            self.storage, "has_deltas", False
+        ):
+            return None
+        from ..delta import codec_for
+
+        codec_cls = codec_for(self.adapter.name)
+        if codec_cls is None:
+            return None
+        d = self._data
+        new_bytes = codec.pack(state_obj)
+        plan = {
+            "new_bytes": new_bytes,
+            "cursor": cursor_obj,
+            "dobj": None,
+            "codec": codec_cls,
+            "base_state": None,
+            "base_name": "",
+            "base_cursor": None,
+        }
+        base = self._delta_base
+        if base is None:
+            return plan
+        try:
+            base_state = self.adapter.state_from_obj(
+                codec.unpack(base["bytes"])
+            )
+            dobj = codec_cls.diff(base_state, d.state)
+        except Exception:
+            logger.warning(
+                "delta diff failed; sealing snapshot only", exc_info=True
+            )
+            trace.add("delta_seal_skipped", 1)
+            return plan
+        if dobj is None:
+            trace.add("delta_seal_skipped", 1)
+            return plan
+        # the size guard and self-verify run in _seal_delta's await half
+        # (everything they read is an immutable plan-owned copy) — only
+        # the diff against the LIVE state needed this sync section
+        plan["dobj"] = dobj
+        plan["base_state"] = base_state
+        plan["base_name"] = base["name"]
+        plan["base_cursor"] = base["cursor"]
+        return plan
+
+    def _set_delta_base(self, name: str, state_bytes: bytes, cursor_obj) -> None:
+        """Retain the just-sealed snapshot as the next diff base.  This
+        is a resident O(state) canonical copy per Core — deliberate
+        (the alternative is re-decrypting the sealed snapshot every
+        compact) but not free at fleet scale, so the cost is published
+        (``delta_base_bytes``, last-writer-wins across cores) and the
+        whole subsystem is opt-out (``OpenOptions.delta`` /
+        ``CRDT_DELTA=0``)."""
+        self._delta_base = {
+            "name": name, "bytes": state_bytes, "cursor": cursor_obj,
+        }
+        trace.gauge("delta_base_bytes", len(state_bytes))
+
+    def _verify_delta_plan(self, plan) -> bool:
+        """The refusal-to-publish guard (worker thread — the plan owns
+        every input, so nothing races the live state): apply the delta
+        to the base copy and require byte-identity with the sealed
+        state.  A codec bug must surface HERE, on the sealer, not as
+        divergence scattered across the fleet (``CRDT_DELTA_VERIFY=0``
+        opts out)."""
+        with trace.span("delta.verify"):
+            try:
+                plan["codec"].apply(plan["base_state"], plan["dobj"])
+                return (
+                    codec.pack(self.adapter.state_to_obj(plan["base_state"]))
+                    == plan["new_bytes"]
+                )
+            except Exception:
+                logger.warning("delta verify crashed", exc_info=True)
+                return False
+
+    async def _seal_delta(self, plan, name: str) -> None:
+        """Await half of the delta seal: wire-build, seal with the data
+        key, publish at the next own-log version (FileExistsError
+        probes forward — the op-file discipline), persist the bumped
+        local-meta cursor, and retain the new base.  A delta-less round
+        (``dobj`` None) wipes the own log instead: a chain that cannot
+        extend to the new snapshot is dead weight every consumer would
+        scan and fall back on."""
+        from ..delta import wire
+        from ..obs.replication import stability_watermark
+
+        d = self._data
+        assert self._local_meta is not None
+        if name == plan["base_name"]:
+            return  # idempotent re-seal of the identical snapshot
+        if plan["dobj"] is not None:
+            if len(codec.pack(plan["dobj"])) >= len(plan["new_bytes"]):
+                # a delta no smaller than the state saves nothing
+                trace.add("delta_seal_skipped", 1)
+                plan["dobj"] = None
+            elif self._delta_verify and not await asyncio.to_thread(
+                self._verify_delta_plan, plan
+            ):
+                logger.warning(
+                    "delta diff does not refold to the sealed state; "
+                    "refusing to publish it (snapshot only)"
+                )
+                trace.add("delta_seal_divergence", 1)
+                plan["dobj"] = None
+        if plan["dobj"] is None:
+            self._set_delta_base(name, plan["new_bytes"], plan["cursor"])
+            last = self._local_meta.last_delta_version
+            if last:
+                trace.add("delta_pruned", 1)
+                await self.storage.remove_deltas([(self.actor_id, last)])
+            return
+        with trace.span("delta.seal"):
+            union = d.next_op_versions.copy()
+            for clock in d.cursor_matrix.values():
+                union.merge(clock)
+            rec = wire.DeltaRecord(
+                base_name=plan["base_name"],
+                new_name=name,
+                base_cursor=VClock.from_obj(plan["base_cursor"]),
+                new_cursor=VClock.from_obj(plan["cursor"]),
+                sealer=self.actor_id,
+                adapter=self.adapter.name,
+                watermark=stability_watermark(
+                    self.actor_id, d.next_op_versions, d.cursor_matrix, union
+                ),
+                delta_obj=plan["dobj"],
+            )
+            blob = await self._seal(wire.build_delta_obj(rec))
+            version = self._local_meta.last_delta_version + 1
+            while True:
+                try:
+                    await self.storage.store_delta(
+                        self.actor_id, version, blob
+                    )
+                    break
+                except FileExistsError:
+                    version += 1
+            self._local_meta.last_delta_version = version
+            vb = VersionBytes(
+                CURRENT_CONTAINER_VERSION,
+                codec.pack(self._local_meta.to_obj()),
+            )
+            await self.storage.store_local_meta(vb.serialize())
+            trace.add("delta_files_sealed", 1)
+            trace.add("delta_bytes_sealed", len(blob))
+            # own-log bound: consumers further than MAX_CHAIN behind
+            # re-read the full snapshot once and rejoin the chain
+            from ..delta import MAX_CHAIN
+
+            if version > MAX_CHAIN:
+                trace.add("delta_pruned", 1)
+                await self.storage.remove_deltas(
+                    [(self.actor_id, version - MAX_CHAIN)]
+                )
+        self._set_delta_base(name, plan["new_bytes"], plan["cursor"])
+
     # --------------------------------------------------------------- compact
     async def compact(self) -> None:
         """Fold everything, snapshot, write-new-then-delete-old
@@ -1617,9 +2015,14 @@ class Core:
             state_obj = _state_obj[0]
         else:
             state_obj = self.adapter.state_to_obj(d.state)
+        cursor_obj = d.next_op_versions.to_obj()
+        snap_mut = getattr(d.state, "_mut", None)
+        # delta plan (diff + self-verify) in the SAME sync section: the
+        # (base, new, delta) triple must be cut from one stable state
+        delta_plan = self._plan_delta_seal(state_obj, cursor_obj)
         payload = [
             state_obj,
-            d.next_op_versions.to_obj(),
+            cursor_obj,
             # sealer id: readers attribute the cursor to this replica in
             # their cursor matrix (StateWrapper's wire note) — old
             # readers index [0]/[1] and never see it
@@ -1627,12 +2030,30 @@ class Core:
         ]
         states_to_remove = sorted(d.read_states)
         ops_to_remove = sorted(d.next_op_versions.counters.items())
+        # consumed-prefix GC covers FOREIGN logs only: the own log is
+        # governed by _seal_delta's MAX_CHAIN bound — a stale reopen
+        # that re-scanned its own chain must not wipe links steady
+        # consumers are still walking
+        deltas_to_remove = sorted(
+            (a, v) for a, v in d.read_deltas.items() if a != self.actor_id
+        )
         with trace.span("compact.seal"):
             blob = await self._seal(payload)
         # crash safety: the new snapshot is durable before anything vanishes
         with trace.span("compact.write"):
             name = await self.storage.store_state(blob)
+        if delta_plan is not None:
+            # the delta lands AFTER its target snapshot is durable (a
+            # crash between the two leaves a snapshot consumers simply
+            # full-read) and BEFORE the GC below
+            await self._seal_delta(delta_plan, name)
         with trace.span("compact.gc"):
+            if deltas_to_remove and self._delta_enabled:
+                # consumed delta prefixes go FIRST: the new snapshot
+                # covers them, and removing them before their target
+                # snapshots keeps any crash window free of dangling
+                # chain heads (docs/delta.md GC ordering)
+                await self.storage.remove_deltas(deltas_to_remove)
             await asyncio.gather(
                 self.storage.remove_states(
                     [n for n in states_to_remove if n != name]
@@ -1645,7 +2066,9 @@ class Core:
         if self._checkpoint_enabled:
             # the freshly compacted state is the ideal warm-open resume
             # point: everything folded, op logs GC'd to the cursor
-            await self.save_checkpoint(_packed=_packed_state)
+            await self.save_checkpoint(
+                _packed=_packed_state, _snap=(name, snap_mut)
+            )
         # local ops are now folded into the snapshot; reset the producer
         # cursor bookkeeping is unnecessary — versions only grow.
         # replication status AFTER the GC + checkpoint seal (backlog is

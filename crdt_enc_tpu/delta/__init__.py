@@ -1,0 +1,64 @@
+"""Delta-state replication (docs/delta.md, ROADMAP item 3).
+
+Full-state snapshots make the remote the fan-in bottleneck: every
+consumer re-downloads O(state) bytes even when only a handful of ops
+landed since its last read.  This package seals, alongside each
+compacted snapshot, an encrypted **delta snapshot** — the state change
+since the sealer's previous snapshot, causally tagged with both
+endpoint cursors and the sealer's PR-6 stability watermark — so an
+incremental consumer folds ``full-at-base + delta chain`` instead of
+re-reading the full snapshot, with automatic fallback to the snapshot
+path on any gap, GC'd link, or fingerprint doubt (traced via the
+``delta_fallbacks`` counter, never silent).
+
+* :mod:`~crdt_enc_tpu.delta.codec` — per-CRDT-type delta codecs:
+  ``diff(base, new)`` cuts a lattice delta whose consumer-side
+  ``apply`` is provably equal to merging the full new snapshot, for
+  any consumer that has merged the base (the delta-state CRDT
+  property; Almeida et al.'s delta-mutators specialized to this
+  repo's columnar state planes).
+* :mod:`~crdt_enc_tpu.delta.wire` — the sealed delta payload: base /
+  new snapshot names (content addresses — the chain's fingerprints),
+  both op-log cursors, the sealer id, the watermark tag, and the
+  codec delta object.
+* :mod:`~crdt_enc_tpu.delta.compose` — composed adapters via the
+  semidirect-product construction (arXiv:2004.04303): the resettable
+  counter (and its scoped undo per arXiv:2006.10494) expressed over
+  the existing OR-Set columnar kernels — new CRDT types without new
+  device kernels.
+
+Deltas live in a per-sealer versioned log (``remote/deltas/
+<actor-hex>/<N>``, the op-log idiom) so GC is the op-file rule:
+consumed prefixes are removed at compaction, own logs are bounded at
+:data:`MAX_CHAIN` links, and anything missing simply falls back to
+the snapshot path.
+"""
+
+from __future__ import annotations
+
+# longest own delta chain a sealer keeps: a consumer more than
+# MAX_CHAIN compactions behind re-reads the full snapshot once and
+# rejoins the chain — bounding both remote clutter and the worst-case
+# chain a reader walks
+MAX_CHAIN = 16
+
+from .codec import codec_for, orset_delta_diff, orset_delta_apply  # noqa: E402
+from .wire import DeltaRecord, build_delta_obj, parse_delta_obj  # noqa: E402
+from .compose import (  # noqa: E402
+    ResettableCounter,
+    UndoError,
+    rcounter_adapter,
+)
+
+__all__ = [
+    "MAX_CHAIN",
+    "codec_for",
+    "orset_delta_diff",
+    "orset_delta_apply",
+    "DeltaRecord",
+    "build_delta_obj",
+    "parse_delta_obj",
+    "ResettableCounter",
+    "UndoError",
+    "rcounter_adapter",
+]

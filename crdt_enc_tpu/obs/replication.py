@@ -55,6 +55,39 @@ def _hex_clock(clock: VClock) -> dict[str, int]:
     return {a.hex(): c for a, c in sorted(clock.counters.items()) if c > 0}
 
 
+def stability_watermark(
+    actor_id: Actor,
+    local_clock: VClock,
+    cursor_matrix: dict[Actor, VClock],
+    union: VClock,
+) -> dict[Actor, int]:
+    """The causal stability watermark: pointwise min over every known
+    replica's cursor (module docs) — factored out of
+    :func:`compute_status` so the delta-replication layer can tag each
+    sealed delta with the sealer's watermark (docs/delta.md) without
+    paying the full status probe.  ``union`` is everything known to
+    exist; replicas are this one, every published cursor, and every
+    actor that ever produced ops."""
+    replicas = set(cursor_matrix) | set(union.counters) | {actor_id}
+    watermark: dict[Actor, int] = {}
+    for a in union.counters:
+        lo = None
+        for r in replicas:
+            if r == actor_id:
+                k = local_clock.get(a)
+            else:
+                published = cursor_matrix.get(r)
+                k = published.get(a) if published is not None else 0
+            if r == a:
+                # implied self-knowledge: a replica has certainly seen
+                # its own sealed ops, published cursor or not
+                k = max(k, union.get(a))
+            lo = k if lo is None else min(lo, k)
+        if lo:
+            watermark[a] = lo
+    return watermark
+
+
 def compute_status(
     actor_id: Actor,
     local_clock: VClock,
@@ -93,22 +126,7 @@ def compute_status(
     # actor that ever produced ops (producers are replicas by
     # construction — op files are written under the writer's actor id).
     replicas = set(cursor_matrix) | set(union.counters) | {actor_id}
-    watermark: dict[Actor, int] = {}
-    for a in union.counters:
-        lo = None
-        for r in replicas:
-            if r == actor_id:
-                k = local_clock.get(a)
-            else:
-                published = cursor_matrix.get(r)
-                k = published.get(a) if published is not None else 0
-            if r == a:
-                # implied self-knowledge: a replica has certainly seen
-                # its own sealed ops, published cursor or not
-                k = max(k, union.get(a))
-            lo = k if lo is None else min(lo, k)
-        if lo:
-            watermark[a] = lo
+    watermark = stability_watermark(actor_id, local_clock, cursor_matrix, union)
 
     actors_behind = sum(
         1 for a, c in union.counters.items() if c > local_clock.get(a)

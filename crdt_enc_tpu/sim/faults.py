@@ -352,7 +352,8 @@ class FaultyStorage(Storage):
         return max(1, first - 1 - int(back * 2))
 
     def _censor_ops(
-        self, files: list[tuple[Actor, int, bytes]], cut: set | None = None
+        self, files: list[tuple[Actor, int, bytes]], cut: set | None = None,
+        family: str = "ops",
     ) -> list[tuple[Actor, int, bytes]]:
         """Apply visibility + torn reads to a dense op run.  A hidden
         file ends its actor's run (density: nothing past it may be
@@ -360,17 +361,20 @@ class FaultyStorage(Storage):
         visibility roll is evaluated for EVERY file — even ones already
         behind a cut — so reveal clocks start at first delivery attempt
         and a run un-hides within ``delay_max_ticks`` instead of one
-        file per tick (a cascade no real sync tool exhibits)."""
+        file per tick (a cascade no real sync tool exhibits).  The
+        delta family shares the censor (``family="deltas"``): hiding a
+        link mid-log models a half-synced chain, which consumers must
+        survive by falling back to the snapshot path."""
         out = []
         ended: set = cut if cut is not None else set()
         for actor, version, raw in files:
-            visible = self._visible("ops", (actor, version))
+            visible = self._visible(family, (actor, version))
             if actor in ended:
                 continue
             if not visible:
                 ended.add(actor)
                 continue
-            out.append((actor, version, self._maybe_tear("ops", raw)))
+            out.append((actor, version, self._maybe_tear(family, raw)))
         return out
 
     async def load_ops(
@@ -423,6 +427,44 @@ class FaultyStorage(Storage):
     async def remove_ops(self, actor_last_versions: list[tuple[Actor, int]]) -> None:
         await self._write(
             "remove_ops", lambda: self.inner.remove_ops(actor_last_versions)
+        )
+
+    # ------------------------------------------------------------- deltas
+    # The delta family inherits the op family's whole failure envelope:
+    # partial actor listings, delayed visibility per file, torn reads,
+    # crash-before/after on publishes and GC.  Deltas are an OPTIMIZATION
+    # layer — every injected fault here must at worst force the consumer
+    # back onto the snapshot path, never diverge it (docs/delta.md).
+    @property
+    def has_deltas(self) -> bool:
+        return getattr(self.inner, "has_deltas", False)
+
+    async def list_delta_actors(self) -> list[Actor]:
+        return self._filter_listing(
+            "dactors", await self.inner.list_delta_actors()
+        )
+
+    async def load_deltas(
+        self, actor_first_versions: list[tuple[Actor, int]]
+    ) -> list[tuple[Actor, int, bytes]]:
+        return self._censor_ops(
+            await self.inner.load_deltas(actor_first_versions),
+            family="deltas",
+        )
+
+    async def store_delta(self, actor: Actor, version: int, data: bytes) -> None:
+        await self._write(
+            "store_delta",
+            lambda: self.inner.store_delta(actor, version, data),
+            landed=lambda _res: self._note_own("deltas", (actor, version)),
+        )
+
+    async def remove_deltas(
+        self, actor_last_versions: list[tuple[Actor, int]]
+    ) -> None:
+        await self._write(
+            "remove_deltas",
+            lambda: self.inner.remove_deltas(actor_last_versions),
         )
 
     # --------------------------------------------------------- lifecycle

@@ -122,6 +122,7 @@ class _Replica:
     core: Core | None = None
     incarnation: int = 0
     last_status: dict | None = None  # per-incarnation monotonicity baseline
+    actor_id: bytes | None = None  # survives crashes (dgc targets it)
 
 
 class SimRunner:
@@ -197,11 +198,13 @@ class SimRunner:
             current_data_version=DEFAULT_DATA_VERSION_1,
             create=create,
             checkpoint=checkpoint,
+            delta=self.schedule.deltas,
             **accel,
         )
 
     async def _open(self, rep: _Replica, *, create: bool) -> None:
         rep.core = await Core.open(self._opts(rep, create=create))
+        rep.actor_id = rep.core.actor_id
         rep.incarnation += 1
         rep.last_status = None  # monotonicity holds per incarnation
 
@@ -247,9 +250,16 @@ class SimRunner:
                         result.violation = violation
                         break
                 if result.violation is None:
-                    result.violation = await self._quiesce_and_check(
-                        len(sched.steps)
-                    )
+                    try:
+                        result.violation = await self._quiesce_and_check(
+                            len(sched.steps)
+                        )
+                    except InvariantViolation:
+                        raise
+                    except Exception as e:
+                        result.violation = Violation(
+                            "check_error", repr(e), len(sched.steps)
+                        )
             except InvariantViolation as iv:
                 result.violation = iv.violation
         for rep in self.replicas:
@@ -298,10 +308,28 @@ class SimRunner:
                 r.storage.tick()
             return None
         if kind == "quiesce":
-            violation = await self._quiesce_and_check(step_idx)
+            try:
+                violation = await self._quiesce_and_check(step_idx)
+            except InvariantViolation:
+                raise
+            except Exception as e:
+                # a checker that cannot even run (open crashes on a
+                # corrupt remote) is itself a finding — surface it as a
+                # shrinkable violation, never a harness traceback
+                violation = Violation("check_error", repr(e), step_idx)
             for r in self.replicas:
                 r.storage.arm()
             return violation
+        if kind == "dgc":
+            # GC-mid-chain: collect the target sealer's whole delta log
+            # out from under every consumer — they must fall back to
+            # the snapshot path, never diverge or stall (docs/delta.md)
+            target = self.replicas[step.arg]
+            if target.actor_id is not None:
+                await self._clean_storage(
+                    f"dgc{step_idx}"
+                ).remove_deltas([(target.actor_id, 1 << 62)])
+            return None
         if kind == "reopen":
             if rep.core is None:
                 try:
@@ -328,9 +356,9 @@ class SimRunner:
                 await rep.core.update(
                     lambda s: s.rm_ctx(m) if s.contains(m) else None
                 )
-            elif kind == "read":
+            elif kind in ("read", "dread"):
                 await rep.core.read_remote()
-            elif kind == "compact":
+            elif kind in ("compact", "dseal"):
                 await rep.core.compact()
             elif kind == "rotate":
                 await rep.core.rotate_key()
@@ -430,6 +458,14 @@ class SimRunner:
                         return Violation(
                             "step_error",
                             f"r{rep.idx} missing key AFTER heal",
+                            step_idx,
+                        )
+                    except Exception as e:
+                        # e.g. DanglingLatestKey: corruption must become
+                        # a shrinkable VIOLATION, never a harness crash
+                        return Violation(
+                            "step_error",
+                            f"r{rep.idx} reopen after heal: {e!r}",
                             step_idx,
                         )
             prev = None

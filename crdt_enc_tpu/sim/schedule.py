@@ -26,7 +26,19 @@ Step kinds (``Step.kind``):
             files move toward visibility)
 ``quiesce`` mid-run quiescence point: heal, drain, run the full
             invariant check, then re-arm the faults
+``dseal``   seal-delta: replica compacts with delta-state replication
+            in play (generated only for ``deltas`` schedules)
+``dread``   read-delta-chain: replica ``read_remote()`` — with deltas
+            on, the chain-first consumer path (docs/delta.md)
+``dgc``     GC-mid-chain: replica ``arg``'s whole delta log is removed
+            out from under every consumer (the hostile move that
+            forces the fallback-to-snapshot path)
 ========== ==================================================================
+
+``Schedule.deltas`` turns delta-state replication on for every
+replica's ``OpenOptions``; it defaults OFF so pre-delta fixtures
+replay bit-for-bit, and the generator only emits the ``d*`` step
+kinds (and only perturbs its RNG stream) when it is on.
 """
 
 from __future__ import annotations
@@ -50,6 +62,9 @@ STEP_KINDS = (
     "reopen",
     "tick",
     "quiesce",
+    "dseal",
+    "dread",
+    "dgc",
 )
 
 
@@ -78,6 +93,7 @@ class Schedule:
     faults: FaultConfig = field(default_factory=FaultConfig)
     members: int = 12
     backend: str = "memory"  # "memory" (deterministic) | "fs"
+    deltas: bool = False  # delta-state replication on every replica
     note: str = ""
 
     def to_obj(self) -> dict:
@@ -87,6 +103,7 @@ class Schedule:
             "replicas": self.n_replicas,
             "members": self.members,
             "backend": self.backend,
+            "deltas": self.deltas,
             "faults": self.faults.to_obj(),
             "steps": [s.to_obj() for s in self.steps],
             "note": self.note,
@@ -107,12 +124,13 @@ class Schedule:
             faults=FaultConfig.from_obj(obj.get("faults", {})),
             members=int(obj.get("members", 12)),
             backend=backend,
+            deltas=bool(obj.get("deltas", False)),
             note=str(obj.get("note", "")),
         )
         bad = [
             s for s in sched.steps
             if not (0 <= s.replica < sched.n_replicas)
-            or (s.kind in ("compact2", "service")
+            or (s.kind in ("compact2", "service", "dgc")
                 and not (0 <= s.arg < sched.n_replicas))
         ]
         if bad:
@@ -127,6 +145,7 @@ class Schedule:
             faults=self.faults,
             members=self.members,
             backend=self.backend,
+            deltas=self.deltas,
             note=self.note,
         )
 
@@ -154,6 +173,16 @@ _WEIGHTS = [
     ("quiesce", 0.02),
 ]
 
+# extra vocabulary for delta-enabled schedules (ROADMAP item-5
+# "Remaining"): explicit seal-delta / read-delta-chain traffic plus the
+# GC-mid-chain hostile move.  Appended ONLY when deltas are on, so the
+# RNG stream — and therefore every pre-delta seed — is untouched.
+_DELTA_WEIGHTS = [
+    ("dseal", 0.06),
+    ("dread", 0.06),
+    ("dgc", 0.02),
+]
+
 
 def generate(
     seed: int,
@@ -163,14 +192,16 @@ def generate(
     *,
     members: int = 12,
     backend: str = "memory",
+    deltas: bool = False,
 ) -> Schedule:
     """One deterministic schedule from a seed.  Every replica both
     writes and syncs; dead replicas receive only ``reopen`` steps; the
     final step list always ends in enough reopens that the quiescence
     phase starts with a full fleet."""
     rng = random.Random(f"crdt-sim-{seed}")
-    kinds = [k for k, _ in _WEIGHTS]
-    weights = [w for _, w in _WEIGHTS]
+    table = _WEIGHTS + (_DELTA_WEIGHTS if deltas else [])
+    kinds = [k for k, _ in table]
+    weights = [w for _, w in table]
     dead: set[int] = set()
     steps: list[Step] = []
     for _ in range(n_steps):
@@ -205,6 +236,9 @@ def generate(
         elif kind in ("compact2", "service"):
             peer = rng.choice(alive)
             steps.append(Step(kind, r, peer))
+        elif kind == "dgc":
+            # arg names the sealer whose delta log gets collected
+            steps.append(Step(kind, r, rng.choice(alive)))
         else:
             steps.append(Step(kind, r))
     for r in sorted(dead):
@@ -216,4 +250,5 @@ def generate(
         faults=faults,
         members=members,
         backend=backend,
+        deltas=deltas,
     )

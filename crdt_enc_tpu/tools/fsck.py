@@ -45,6 +45,7 @@ class FsckReport:
     op_files: int = 0
     op_actors: int = 0
     ops_decoded: int = 0
+    delta_files: int = 0
     keys_found: int = 0
     issues: list = field(default_factory=list)
 
@@ -58,10 +59,11 @@ class FsckReport:
     def summary(self) -> str:
         errors = sum(1 for i in self.issues if i.severity == "error")
         warns = len(self.issues) - errors
+        deltas = f", {self.delta_files} deltas" if self.delta_files else ""
         return (
             f"{'OK' if self.ok else 'DAMAGED'}: {self.meta_files} meta, "
             f"{self.state_files} states, {self.op_files} op files across "
-            f"{self.op_actors} actors ({self.ops_decoded} ops), "
+            f"{self.op_actors} actors ({self.ops_decoded} ops){deltas}, "
             f"{self.keys_found} data keys; {errors} error(s), {warns} warning(s)"
         )
 
@@ -213,6 +215,9 @@ async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> Fs
             if deep:
                 files = await storage.load_ops([(actor, floor)])
                 await _deep_check_ops(report, open_sealed, hexa, files)
+    # ---- delta snapshots -------------------------------------------------
+    await _check_deltas(report, storage, open_sealed, deep=deep)
+
     trace.add("fsck_ops_decoded", report.ops_decoded)
     if not latest_ok and (
         report.meta_files or report.keys_found
@@ -223,6 +228,185 @@ async def fsck_remote(storage, cryptor, key_cryptor, *, deep: bool = True) -> Fs
             "no resolvable latest data key (key metadata lost?)",
         )
     return report
+
+
+def _adapter_for_name(name: bytes):
+    """Adapter instance for a delta payload's adapter name, or None —
+    the refold check is skipped for types this build cannot decode."""
+    key = bytes(name).decode(errors="replace")
+    if key == "rcounter":
+        from ..delta.compose import rcounter_adapter
+
+        return rcounter_adapter()
+    ctor = ADAPTERS.get(key)
+    if ctor is None:
+        return None
+    from ..core import adapters as _adapters
+
+    return getattr(_adapters, ctor)()
+
+
+async def _check_deltas(report, storage, open_sealed, *, deep: bool) -> None:
+    """Validate the delta file family (docs/delta.md):
+
+    * **broken chains** — interior version gaps in a sealer's log
+      (logs are append-only and GC removes only prefixes, so a hole
+      with links beyond it is damage), and payloads missing the base
+      watermark / cursors / names (malformed) — error rows;
+    * **orphan deltas** — a link filed under one sealer's log whose
+      payload names a different sealer: misfiled by the sync tool,
+      unusable and misleading — error row;
+    * **delta-vs-refold byte divergence** — whenever BOTH endpoint
+      snapshots are still present, the base state + delta must refold
+      byte-identically to the target snapshot's state — error row;
+    * anchoring looseness is WARNED, not failed: a link's base may
+      legitimately be an *earlier* anchor than its predecessor's
+      target (a stale-checkpoint reopen re-anchors the chain), and a
+      chain head may target a snapshot a superseding compactor GC'd —
+      consumers holding the base name still apply such links, everyone
+      else falls back.
+    """
+    if not getattr(storage, "has_deltas", False):
+        return
+    from ..delta import codec_for, wire
+
+    with trace.span("fsck.deltas"):
+        try:
+            actors = await storage.list_delta_actors()
+        except Exception as e:
+            report.add("error", "deltas", "listing", f"unlistable: {e}")
+            return
+        state_names = set(await storage.list_state_names())
+        for actor in actors:
+            hexa = actor.hex()
+            versions = await _list_delta_versions(storage, actor)
+            if versions is None:
+                report.add(
+                    "warn", "deltas", hexa,
+                    "storage backend cannot enumerate delta versions; "
+                    "gap detection skipped",
+                )
+                versions = []
+            if versions:
+                floor = versions[0]
+                expected = set(range(floor, floor + len(versions)))
+                missing = sorted(expected - set(versions))
+                if missing:
+                    report.add(
+                        "error", "deltas", hexa,
+                        f"broken chain: gap at version {missing[0]} "
+                        "(GC removes only prefixes — an interior hole "
+                        "is damage)",
+                    )
+            if not deep:
+                report.delta_files += len(versions)
+                continue
+            files = await storage.load_deltas([(actor, 1)])
+            report.delta_files += len(files)
+            records: list[tuple] = []  # (version, record) that parsed
+            for _, version, raw in files:
+                try:
+                    obj = await open_sealed(raw)
+                    rec = wire.parse_delta_obj(obj)
+                except Exception as e:
+                    report.add(
+                        "error", "deltas", f"{hexa}:{version}", f"{e}"
+                    )
+                    continue
+                if rec.sealer != actor:
+                    report.add(
+                        "error", "deltas", f"{hexa}:{version}",
+                        "orphan delta: payload sealer "
+                        f"{rec.sealer.hex()} does not own this log",
+                    )
+                    continue
+                records.append((version, rec))
+            # base anchoring: a link need not chain from its IMMEDIATE
+            # predecessor (a stale-checkpoint reopen legitimately
+            # re-anchors at an earlier own snapshot), but its base must
+            # resolve SOMEWHERE — an earlier link's target or a listed
+            # state.  Unresolvable is a warning (the anchor may have
+            # been GC'd after consumers learned it), never silent.
+            targets = {rec.new_name for _, rec in records}
+            for version, rec in records:
+                if (
+                    rec.base_name not in targets
+                    and rec.base_name not in state_names
+                    and records[0][0] != version  # oldest link's base
+                    # is routinely a GC'd predecessor target
+                ):
+                    report.add(
+                        "warn", "deltas", f"{hexa}:{version}",
+                        f"unanchored chain link: base "
+                        f"{rec.base_name[:16]}… resolves to no listed "
+                        "snapshot or log target",
+                    )
+                if rec.base_name in state_names and rec.new_name in state_names:
+                    await _check_delta_refold(
+                        report, storage, open_sealed, hexa, version, rec,
+                        codec_for(rec.adapter),
+                    )
+            if records and records[-1][1].new_name not in state_names:
+                report.add(
+                    "warn", "deltas", f"{hexa}:{records[-1][0]}",
+                    "chain head targets a GC'd snapshot; consumers "
+                    "holding the base still apply it, everyone else "
+                    "falls back",
+                )
+
+
+async def _check_delta_refold(
+    report, storage, open_sealed, hexa, version, rec, codec_cls
+) -> None:
+    adapter = _adapter_for_name(rec.adapter)
+    if adapter is None or codec_cls is None:
+        report.add(
+            "warn", "deltas", f"{hexa}:{version}",
+            f"adapter {rec.adapter!r} unknown here; refold check skipped",
+        )
+        return
+    loaded = dict(await storage.load_states([rec.base_name, rec.new_name]))
+    if len(loaded) < 2:
+        return  # racing GC; both were listed a moment ago
+    try:
+        base_obj = await open_sealed(loaded[rec.base_name])
+        new_obj = await open_sealed(loaded[rec.new_name])
+        base_state = adapter.state_from_obj(base_obj[0])
+        codec_cls.apply(base_state, rec.delta_obj)
+        refolded = codec.pack(adapter.state_to_obj(base_state))
+        target = codec.pack(adapter.state_to_obj(
+            adapter.state_from_obj(new_obj[0])
+        ))
+    except Exception as e:
+        report.add(
+            "error", "deltas", f"{hexa}:{version}", f"refold failed: {e}"
+        )
+        return
+    if refolded != target:
+        report.add(
+            "error", "deltas", f"{hexa}:{version}",
+            f"delta-vs-refold divergence: base+delta ({len(refolded)}B "
+            f"canonical) != target snapshot ({len(target)}B canonical)",
+        )
+
+
+async def _list_delta_versions(storage, actor) -> list[int] | None:
+    """Sorted delta versions for one sealer without reading bytes, or
+    None when the backend cannot enumerate them."""
+    deltas_dir = getattr(storage, "_deltas_dir", None)
+    if deltas_dir is not None:
+        import os
+
+        try:
+            names = os.listdir(deltas_dir(actor))
+        except FileNotFoundError:
+            return []
+        return sorted(int(n) for n in names if n.isdigit())
+    table = getattr(storage, "remote", None)
+    deltas = getattr(table, "deltas", None)
+    if isinstance(deltas, dict):  # MemoryRemote: {actor: {version: bytes}}
+        return sorted(int(v) for v in deltas.get(actor, {}))
+    return None
 
 
 async def _deep_check_ops(report, open_sealed, hexa: str, files: list) -> None:
@@ -386,6 +570,10 @@ ADAPTERS = {
     "map": "map_adapter",
 }
 
+# composed adapters (delta/compose.py) resolve through _adapter_for_name,
+# which special-cases them; they are CLI-selectable like the rest
+CLI_ADAPTERS = sorted(ADAPTERS) + ["rcounter"]
+
 
 async def _list_op_versions(storage, actor) -> list[int] | None:
     """Sorted op-file versions for one actor WITHOUT reading file bytes,
@@ -427,7 +615,7 @@ def main(argv=None) -> int:
                     help="additionally verify LOCAL_DIR's fold checkpoint: "
                     "refold the remote up to the checkpoint cursor and "
                     "byte-compare (error row + exit 1 on divergence)")
-    ap.add_argument("--adapter", default="orset", choices=sorted(ADAPTERS),
+    ap.add_argument("--adapter", default="orset", choices=CLI_ADAPTERS,
                     help="CRDT adapter for checkpoint/op decoding "
                     "(--verify-checkpoint only; default orset)")
     args = ap.parse_args(argv)
@@ -453,12 +641,10 @@ def main(argv=None) -> int:
                 storage, XChaChaCryptor(), make_kc(), deep=not args.shallow
             )
             if args.verify_checkpoint:
-                from ..core import adapters as _adapters
-
                 local = FsStorage(args.verify_checkpoint, args.remote)
                 vc = await verify_checkpoint(
                     local, storage, XChaChaCryptor(), make_kc(),
-                    adapter=getattr(_adapters, ADAPTERS[args.adapter])(),
+                    adapter=_adapter_for_name(args.adapter.encode()),
                 )
                 report.issues.extend(vc.issues)
         for issue in report.issues:

@@ -81,7 +81,7 @@ def _cmd_run(args) -> int:
     faults = _build_faults(args.faults)
     schedule = generate(
         args.seed, args.replicas, args.steps, faults,
-        members=args.members, backend=args.backend,
+        members=args.members, backend=args.backend, deltas=args.deltas,
     )
     result = _execute(schedule)
     _report("run", schedule, result)
@@ -115,7 +115,7 @@ def _cmd_explore(args) -> int:
     for seed in range(lo, hi):
         schedule = generate(
             seed, args.replicas, args.steps, faults,
-            members=args.members, backend=args.backend,
+            members=args.members, backend=args.backend, deltas=args.deltas,
         )
         result = _execute(schedule)
         _report(f"seed {seed}", schedule, result)
@@ -192,6 +192,10 @@ def main(argv=None) -> int:
                        help="all | none | comma-list of fault classes")
         p.add_argument("--backend", choices=("memory", "fs"),
                        default="memory")
+        p.add_argument("--deltas", action="store_true",
+                       help="enable delta-state replication on every "
+                       "replica + the dseal/dread/dgc step vocabulary "
+                       "(docs/delta.md)")
 
     p_run = sub.add_parser("run", help="one seeded schedule + checks")
     p_run.add_argument("--seed", type=int, default=0)

@@ -70,6 +70,32 @@ def test_multitenant_smoke_emits_one_json_line():
     assert rec["warm_cycle"]["warm_hits"] == 4
 
 
+def test_delta_smoke_emits_one_json_line():
+    """The ISSUE-10 bench end-to-end on a tiny CPU remote: one JSON
+    line, byte-identity + chains-applied asserted inside the run (a
+    divergence or an unused chain exits 1)."""
+    r = subprocess.run(
+        [sys.executable, _BENCH, "--e2e-delta", "--smoke"],
+        env=_env(
+            JAX_PLATFORMS="cpu", BENCH_LOCAL_DISABLE="1",
+            BENCH_DELTA_OPS="3000", BENCH_DELTA_REPLICAS="40",
+            BENCH_DELTA_MEMBERS="48", BENCH_DELTA_ROUNDS="2",
+        ),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "orset_e2e_delta_bytes_reduction"
+    assert rec["unit"] == "x"
+    assert rec["byte_identical"] is True
+    assert rec["deltas_applied"] == 2
+    # the whole point: the incremental consumer reads far fewer bytes
+    assert rec["value"] >= 5
+    assert rec["bytes_read_delta_path"] < rec["bytes_read_snapshot_path"]
+
+
 def test_unavailable_backend_emits_diagnostic_and_exit_3():
     # non-smoke + no TPU: the subprocess probe sees a CPU-only backend,
     # retries are configured to a single fast attempt, and the bench

@@ -208,7 +208,11 @@ def test_crash_after_op_write_before_cursor_update(fs_factory, tmp_path):
         remote = str(tmp_path / "remote")
 
         crashy = CrashStorage(
-            FsStorage(local, remote), "store_local_meta", when="before", skip=1
+            FsStorage(local, remote), "store_local_meta", when="before",
+            # skip the two open-time local-meta writes (replica
+            # identity + the key-mint last_key_dot cursor) so the
+            # crash lands on the producer-cursor persist in update
+            skip=2
         )
         c1 = await Core.open(make_opts(crashy, gcounter_adapter()))
         actor = c1.actor_id
@@ -252,7 +256,11 @@ def test_restart_without_read_remote_probes_past_leaked_file(fs_factory, tmp_pat
         remote = str(tmp_path / "remote")
 
         crashy = CrashStorage(
-            FsStorage(local, remote), "store_local_meta", when="before", skip=1
+            FsStorage(local, remote), "store_local_meta", when="before",
+            # skip the two open-time local-meta writes (replica
+            # identity + the key-mint last_key_dot cursor) so the
+            # crash lands on the producer-cursor persist in update
+            skip=2
         )
         c1 = await Core.open(make_opts(crashy, gcounter_adapter()))
         actor = c1.actor_id

@@ -317,6 +317,52 @@ def test_reopened_producer_refuses_write_when_history_hidden():
     run(go())
 
 
+def test_reopen_refuses_key_remint_when_meta_hidden():
+    """The key_dot_reuse_partial_meta fixture's bug class, unit-pinned:
+    a replica reopening while its own key-register write is hidden (a
+    partially synced meta listing) must NOT re-bootstrap a data key —
+    the fresh mint would reuse keys-ORSet dot (actor, 1), the Orswot
+    merge would kill one key's material, and the latest-register
+    tie-break can leave the whole remote pointing at a dead id
+    (DanglingLatestKey on every open).  The durable
+    ``LocalMeta.last_key_dot`` cursor refuses the mint loudly instead;
+    once the register syncs back, the reopen needs no mint at all and
+    the fleet's key material survives intact."""
+    from crdt_enc_tpu.core import MissingKeyError
+
+    class MetaBlindStorage(MemoryStorage):
+        """The converged key register has not synced back."""
+
+        async def list_remote_meta_names(self):
+            return []
+
+    async def go():
+        remote = MemoryRemote()
+        storage = MemoryStorage(remote)
+        a = await Core.open(make_opts(storage))
+        await a.update(lambda s: s.add_ctx(a.actor_id, "sealed-pre-crash"))
+        key_id = a._data.keys.latest_key().id
+        # crash; reopen sees NO meta files → bootstrap wants to mint,
+        # but dot (actor, 1) was already spent on the pre-crash key
+        blind = MetaBlindStorage(remote)
+        blind._local_meta = storage._local_meta
+        with pytest.raises(MissingKeyError):
+            await Core.open(make_opts(blind, create=False))
+        # after the sync heals, the reopen needs no mint: same key,
+        # no dangling register, data still readable
+        b = await Core.open(make_opts(storage, create=False))
+        assert b._data.keys.latest_key().id == key_id
+        await b.read_remote()
+        assert b.with_state(lambda s: s.contains("sealed-pre-crash"))
+        reader = await Core.open(make_opts(MemoryStorage(remote)))
+        await reader.read_remote()
+        assert reader.with_state(canonical_bytes) == b.with_state(
+            canonical_bytes
+        )
+
+    run(go())
+
+
 # -------------------------------------------------- fs concurrent-GC races
 def test_fs_reader_survives_real_concurrent_gc(tmp_path):
     """The satellite-2 race, deterministically interleaved: B lists the

@@ -27,6 +27,19 @@ JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
     --seed 0 --replicas 4 --steps 80 --faults all
 JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim replay tests/data/sim
 
+echo "== delta-enabled sim smoke (bounded) =="
+# the same all-faults envelope with delta-state replication on and the
+# dseal/dread/dgc vocabulary in play (docs/delta.md)
+JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
+    --seed 0 --replicas 4 --steps 80 --faults all --deltas
+
+echo "== delta-vs-snapshot differential gate =="
+# chained delta consumers must be byte-identical to full-snapshot
+# consumers across adapters (incl. the composed resettable counter)
+# and both storage backends (docs/delta.md)
+JAX_PLATFORMS=cpu python -m pytest tests/test_delta.py -q \
+    -p no:cacheprovider -k "differential or rides_device_kernels"
+
 echo "== obs_report fleet golden =="
 python -m crdt_enc_tpu.tools.obs_report fleet \
     tests/data/fleet_device_a.jsonl tests/data/fleet_device_b.jsonl \
