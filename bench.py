@@ -472,6 +472,15 @@ def e2e_streaming(smoke: bool):
     best_n = min(raw_times, key=raw_times.get)
     t_ovl = raw_times[best_n]  # unrounded — display rounding must not
     rate = total_ops / t_ovl   # leak into the recorded rate/ratios
+    # machine-checked critical-path attribution of the best pass: the
+    # ROADMAP-item-1 "where did the time go" claim as a number with a
+    # trend trajectory (obs.attribution; render with `obs_report gap`)
+    from crdt_enc_tpu.obs import attribution
+
+    gap_report = attribution.attribute_cycle(
+        sweep[best_n]["obs"], pipeline="streaming", wall_s=t_ovl,
+        ops=total_ops,
+    )
     result = {
         "metric": "orset_e2e_streaming_ops_per_sec",
         "config": "mixed_streaming_100k_e2e",
@@ -488,6 +497,7 @@ def e2e_streaming(smoke: bool):
             for n, rec in sweep.items()
         },
         "stage_marginals_s": sweep[best_n]["stage_marginals_s"],
+        "gap_report": gap_report,
         "full_batch_equal": bool(full_batch_equal),
         "backend": dev.platform,
     }
@@ -796,6 +806,13 @@ def e2e_multitenant(smoke: bool):
     agg_serve = total_ops / t_serve
     agg_seq = total_ops / t_seq
     speedup = t_seq / t_serve
+    # critical-path attribution of the best service cycle (obs
+    # .attribution; the serve twin of the streaming gap report)
+    from crdt_enc_tpu.obs import attribution
+
+    gap_report = attribution.attribute_cycle(
+        obs_serve, pipeline="serve", wall_s=t_serve, ops=total_ops
+    )
     log(
         f"sequential {t_seq:.2f}s ({agg_seq:,.0f} ops/s) vs service "
         f"{t_serve:.2f}s ({agg_serve:,.0f} ops/s) → {speedup:.2f}x; "
@@ -817,6 +834,7 @@ def e2e_multitenant(smoke: bool):
         "tenant_latency": _quantiles_ms(serve_lat),
         "sequential_tenant_latency": _quantiles_ms(seq_lat),
         "fold_paths": paths,
+        "gap_report": gap_report,
         "warm_cycle": {
             "tail_ops": n_tail_ops,
             "cycle_s": round(t_warm, 4),

@@ -15,6 +15,12 @@ Re-pin deliberately (a better box, a protocol change) — never as part
 of a bench run; the whole point is that the denominator does not move
 with the weather.  bench.py / suite.py pick the pin up automatically
 when the workload shape matches (``bench.load_pinned``).
+
+Spread gate (VERDICT item 4): a pin measured on a noisy box is a noisy
+denominator forever, so a config whose ``host_spread_pct`` exceeds
+:data:`SPREAD_LIMIT_PCT` is REFUSED (exit 1, nothing written for that
+config).  ``--force`` overrides with a printed warning — for when the
+spread is the box's honest steady state and you accept it knowingly.
 """
 
 from __future__ import annotations
@@ -28,6 +34,35 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+#: maximum tolerated host sample spread for a committed pin, in percent
+#: — above this the box was not idle enough to be a denominator.
+SPREAD_LIMIT_PCT = 30.0
+
+
+def spread_gate(config_name: str, rec: dict, force: bool = False) -> bool:
+    """Whether ``rec`` (one measured pin record) may be written.
+    Refuses — with the reason printed — when ``host_spread_pct``
+    exceeds :data:`SPREAD_LIMIT_PCT`; ``force`` overrides with a
+    printed warning instead (the operator owns the judgment call)."""
+    spread = rec.get("host_spread_pct")
+    if spread is None or float(spread) <= SPREAD_LIMIT_PCT:
+        return True
+    if force:
+        print(
+            f"WARNING: pinning {config_name} with host_spread_pct "
+            f"{float(spread):.1f} > {SPREAD_LIMIT_PCT:.0f} (--force): "
+            "this denominator carries the noise of a busy box",
+            file=sys.stderr,
+        )
+        return True
+    print(
+        f"REFUSING to pin {config_name}: host_spread_pct "
+        f"{float(spread):.1f} > {SPREAD_LIMIT_PCT:.0f} — rerun on an "
+        "idle box, or pass --force to accept the noisy denominator",
+        file=sys.stderr,
+    )
+    return False
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -35,6 +70,8 @@ def main():
                     help="host runs per config (default BENCH_HOST_RUNS)")
     ap.add_argument("--config", type=int, default=0,
                     help="re-pin one config (1-5) only")
+    ap.add_argument("--force", action="store_true",
+                    help="write pins even past the spread gate (warns)")
     args = ap.parse_args()
     if args.runs:
         os.environ["BENCH_HOST_RUNS"] = str(args.runs)
@@ -71,6 +108,7 @@ def main():
     wanted = [args.config] if args.config else sorted(runners)
     ts = datetime.datetime.now(datetime.timezone.utc).isoformat(
         timespec="seconds")
+    refused = []
     for c in wanted:
         print(f"pinning config {c}…", file=sys.stderr, flush=True)
         r = runners[c]()
@@ -83,6 +121,9 @@ def main():
             "host_spread_pct": r["host_spread_pct"],
             "ts": ts,
         }
+        if not spread_gate(r["config"], rec, force=args.force):
+            refused.append(r["config"])
+            continue
         pins[r["config"]] = rec
         print(json.dumps({r["config"]: rec}), flush=True)
 
@@ -90,6 +131,8 @@ def main():
         json.dump(pins, f, indent=1, sort_keys=True)
         f.write("\n")
     print(f"wrote {PINNED_PATH}", file=sys.stderr)
+    if refused:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -509,6 +509,17 @@ class Core:
             logger.debug("replication status sampling failed", exc_info=True)
             return None
         replication.sample(status)
+        # freshness-SLO gauges + live /healthz publication: both are
+        # no-ops-with-one-check unless opted in (CRDT_OBS_HTTP / a
+        # configured server), and neither may kill the run it observes
+        try:
+            from ..obs import live as obs_live
+            from ..obs import slo as obs_slo
+
+            obs_slo.sample_freshness(status)
+            obs_live.publish(status)
+        except Exception:
+            logger.debug("slo/live sampling failed", exc_info=True)
         return status
 
     # ----------------------------------------------------------- key rotation

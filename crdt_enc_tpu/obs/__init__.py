@@ -25,13 +25,39 @@ is the full layer on top of the span/counter registry PR 1 seeded:
 * :mod:`.fleet`    — cross-device aggregation of sink files: fleet
   stable watermark, convergence-lag distribution, backlog quantiles,
   and the BENCH_LOCAL perf-trend table with regression flagging.
+* :mod:`.live`     — the live telemetry plane (ISSUE 11): an embedded
+  HTTP endpoint serving ``/metrics`` (Prometheus exposition from the
+  LIVE registry), ``/healthz`` (per-remote watermark/backlog/cycle
+  health) and ``/snapshot``; opt-in via ``CRDT_OBS_HTTP`` or
+  ``FoldService(live_port=...)``, never on the hot path.
+* :mod:`.attribution` — cycle attribution: stage marginals
+  (decrypt/decode/h2d/fold/scatter/seal), overlap efficiency,
+  critical-path stage, and the e2e-vs-fold-marginal **gap report**
+  (``obs_report gap``).
+* :mod:`.slo`      — freshness SLOs: staleness-lag-vs-watermark and
+  per-tenant seal-latency targets, live ``repl_slo_*`` gauges, and
+  window-based burn accounting over sink records (``obs_report slo``).
 
 CLI: ``python -m crdt_enc_tpu.tools.obs_report`` renders phase tables,
-exports timelines, diffs runs, and aggregates fleets
-(``fleet``/``trend``).  Span/metric names are registered in
-``docs/observability.md`` and linted by ``tools/check_span_names.py``.
+exports timelines, diffs runs, aggregates fleets (``fleet``/``trend``),
+attributes cycles (``gap``) and accounts SLO burn (``slo``).
+Span/metric names are registered in ``docs/observability.md`` and
+linted by ``tools/check_span_names.py``.
 """
 
-from . import fleet, record, replication, runtime, sink, timeline
+from . import (
+    attribution,
+    fleet,
+    live,
+    record,
+    replication,
+    runtime,
+    sink,
+    slo,
+    timeline,
+)
 
-__all__ = ["fleet", "record", "replication", "runtime", "sink", "timeline"]
+__all__ = [
+    "attribution", "fleet", "live", "record", "replication", "runtime",
+    "sink", "slo", "timeline",
+]

@@ -34,7 +34,7 @@ from __future__ import annotations
 import json
 import math
 
-from . import sink
+from . import sink, slo
 
 
 class FleetInputError(ValueError):
@@ -105,6 +105,7 @@ def fleet_report(summaries: list[dict]) -> dict:
             )
             if lo:
                 watermark[a] = lo
+        freshness = slo.freshness_spec()
         devices = []
         for s in devs:
             rep = s["replication"]
@@ -116,6 +117,10 @@ def fleet_report(summaries: list[dict]) -> dict:
                 "backlog_files": rep["backlog"]["files"],
                 "backlog_bytes": rep["backlog"]["bytes"],
                 "watermark_lag": rep["divergence"]["watermark_lag"],
+                # freshness-SLO verdict at the device's last sample:
+                # watermark lag within the active target (obs.slo)
+                "slo_ok": rep["divergence"]["watermark_lag"]
+                <= freshness.target,
             })
         lags = [d["lag"] for d in devices]
         bfiles = [d["backlog_files"] for d in devices]
@@ -124,6 +129,13 @@ def fleet_report(summaries: list[dict]) -> dict:
             "remote_id": remote_id,
             "devices": devices,
             "converged": all(v == 0 for v in lags),
+            "slo": {
+                "freshness_target": freshness.target,
+                "devices_ok": sum(1 for d in devices if d["slo_ok"]),
+                "devices_burning": sum(
+                    1 for d in devices if not d["slo_ok"]
+                ),
+            },
             "stable_watermark": dict(sorted(watermark.items())),
             "union_clock": dict(sorted(union.items())),
             "lag": {
@@ -167,11 +179,17 @@ def format_fleet(report: dict) -> str:
             f"  backlog files p50={bf['p50']} p99={bf['p99']}  "
             f"bytes p50={bb['p50']} p99={bb['p99']}"
         )
+        s = r["slo"]
+        lines.append(
+            f"  slo freshness (lag<={s['freshness_target']:g}): "
+            f"{s['devices_ok']} ok, {s['devices_burning']} burning"
+        )
         for d in r["devices"]:
             lines.append(
                 f"  device {d['actor']}  lag={d['lag']}  "
                 f"backlog_files={d['backlog_files']}  "
-                f"backlog_bytes={d['backlog_bytes']}"
+                f"backlog_bytes={d['backlog_bytes']}  "
+                f"slo={'ok' if d['slo_ok'] else 'BURN'}"
             )
     return "\n".join(lines)
 
@@ -189,12 +207,21 @@ def bench_trend(records: list[dict], metric: str | None = None) -> list[dict]:
             continue
         if metric is not None and rec["metric"] != metric:
             continue
-        shape = json.dumps(rec.get("shape", {}), sort_keys=True)
+        # shapeless records (the sim bench) fall back to their config
+        # string — without it, e.g. a 4r×50s and an 8r×250s sim run
+        # would collapse into ONE trajectory and the regression gate
+        # would compare apples to oranges
+        shape_obj = rec.get("shape")
+        if not isinstance(shape_obj, dict) or not shape_obj:
+            shape_obj = (
+                {"config": rec["config"]} if rec.get("config") else {}
+            )
+        shape = json.dumps(shape_obj, sort_keys=True)
         key = (rec["metric"], rec.get("backend", "?"), shape)
         cfg = configs.setdefault(key, {
             "metric": rec["metric"],
             "backend": rec.get("backend", "?"),
-            "shape": rec.get("shape", {}),
+            "shape": shape_obj,
             "unit": rec.get("unit", ""),
             "runs": [],
         })

@@ -47,6 +47,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import time
 from pathlib import Path
 
@@ -76,6 +77,13 @@ def _max_sink_bytes() -> int:
     except ValueError:
         return 0
     return int(mb * 1e6) if mb > 0 else 0
+
+
+#: serializes the size-check → rotate → append sequence across threads
+#: (a service's per-tenant seals write concurrently): without it two
+#: writers could both rotate, dropping a generation, or interleave the
+#: check with another's append and overshoot the bound.
+_io_lock = threading.Lock()
 
 
 class MetricsSink:
@@ -114,15 +122,17 @@ class MetricsSink:
             rec["replication"] = replication
         try:
             line = json.dumps(rec)
-            limit = _max_sink_bytes()
-            if limit:
-                try:
-                    if os.path.getsize(self.path) + len(line) + 1 > limit:
-                        os.replace(self.path, self.path + ".1")
-                except OSError:
-                    pass  # no file yet, or a racing rotation — append wins
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+            with _io_lock:
+                limit = _max_sink_bytes()
+                if limit:
+                    try:
+                        if os.path.getsize(self.path) + len(line) + 1 \
+                                > limit:
+                            os.replace(self.path, self.path + ".1")
+                    except OSError:
+                        pass  # no file yet — first append creates it
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
         except (OSError, TypeError, ValueError):
             pass
         return rec
@@ -223,8 +233,10 @@ def registry_help() -> dict[str, str]:
         m = _ROW_RE.match(line)
         if not m or m.group(1) in ("span", "name"):
             continue
+        # raw text here; escaping for the exposition format happens at
+        # render time (_escape_help) so it applies uniformly to registry
+        # and fallback help strings alike
         desc = m.group(2).strip().replace("`", "")
-        desc = desc.replace("\\", "\\\\").replace("\n", " ")
         if desc:
             out.setdefault(m.group(1), desc)
     _help_cache = out
@@ -233,6 +245,20 @@ def registry_help() -> dict[str, str]:
 
 def _metric_name(prefix: str, name: str) -> str:
     return f"{prefix}_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+# Prometheus text-format escaping (the exposition spec): label VALUES
+# escape backslash, double-quote and newline; HELP text escapes
+# backslash and newline.  Metric names need none (sanitized above), but
+# span names ride as label values and are dotted free text.
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
 
 
 def to_prometheus(snap: dict | None = None, prefix: str = "crdt",
@@ -262,7 +288,7 @@ def to_prometheus(snap: dict | None = None, prefix: str = "crdt",
             f"# TYPE {prefix}_span_seconds summary",
         ]
     for name, v in sorted(snap.get("spans", {}).items()):
-        lab = f'{{span="{name}"}}'
+        lab = f'{{span="{_escape_label(name)}"}}'
         lines.append(
             f"{prefix}_span_seconds_total{lab} {v['seconds']:.6f}{ts}"
         )
@@ -271,19 +297,21 @@ def to_prometheus(snap: dict | None = None, prefix: str = "crdt",
             ms = v.get(f"{q}_ms")
             if ms is not None:
                 lines.append(
-                    f'{prefix}_span_seconds{{span="{name}",quantile='
-                    f'"0.{q[1:]}"}} {ms / 1e3:.6f}{ts}'
+                    f'{prefix}_span_seconds{{span="{_escape_label(name)}"'
+                    f',quantile="0.{q[1:]}"}} {ms / 1e3:.6f}{ts}'
                 )
     for name, v in sorted(snap.get("counters", {}).items()):
         fam = _metric_name(prefix, name)
         if not fam.endswith("_total"):
             fam += "_total"
-        lines.append(f"# HELP {fam} {help_.get(name, f'counter {name}')}")
+        h = _escape_help(help_.get(name, f"counter {name}"))
+        lines.append(f"# HELP {fam} {h}")
         lines.append(f"# TYPE {fam} counter")
         lines.append(f"{fam} {v}{ts}")
     for name, v in sorted(snap.get("gauges", {}).items()):
         fam = _metric_name(prefix, name)
-        lines.append(f"# HELP {fam} {help_.get(name, f'gauge {name}')}")
+        h = _escape_help(help_.get(name, f"gauge {name}"))
+        lines.append(f"# HELP {fam} {h}")
         lines.append(f"# TYPE {fam} gauge")
         lines.append(f"{fam} {v}{ts}")
     return "\n".join(lines) + "\n"

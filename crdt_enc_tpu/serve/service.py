@@ -60,6 +60,7 @@ obs registry) — ``bench.py --e2e-multitenant`` publishes them.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from dataclasses import dataclass, field
 
@@ -72,6 +73,8 @@ from ..utils import codec, trace
 from . import bucketing
 from .bucketing import TenantShape, _bucket, plan_buckets
 from .warm import DEFAULT_BYTE_BUDGET, PlaneWarmTier
+
+logger = logging.getLogger("crdt_enc_tpu.serve")
 
 
 @dataclass
@@ -213,7 +216,8 @@ class FoldService:
     always was.
     """
 
-    def __init__(self, tenants, config: ServeConfig | None = None):
+    def __init__(self, tenants, config: ServeConfig | None = None,
+                 live_port: int | None = None):
         self.tenants = list(tenants)
         self.config = config if config is not None else ServeConfig()
         self.warm = (
@@ -221,6 +225,26 @@ class FoldService:
             if self.config.warm
             else None
         )
+        # service-owned live telemetry endpoint (obs/live.py): /metrics,
+        # /healthz (per-tenant watermarks + the last cycle summary),
+        # /snapshot.  live_port=0 binds an ephemeral port (see
+        # self.live.port); None = no server (the process-default
+        # CRDT_OBS_HTTP server, if any, still receives publications).
+        self.live = None
+        if live_port is not None:
+            from ..obs.live import LiveTelemetryServer
+
+            self.live = LiveTelemetryServer(port=live_port)
+            self.live.start()
+        # last cycle's summary (tenant paths, wall, SLO burn) — what
+        # /healthz shows and the cycle sink record carries
+        self.last_cycle_summary: dict | None = None
+
+    def close(self) -> None:
+        """Graceful shutdown of service-owned resources (the live
+        telemetry listener; tenants stay open — they are the caller's)."""
+        if self.live is not None:
+            self.live.stop()
 
     # ------------------------------------------------------------- cycle
     async def run_cycle(self) -> list[TenantResult]:
@@ -242,7 +266,58 @@ class FoldService:
             await self._seal_all(works, t0)
         trace.add("serve_cycles", 1)
         trace.add("serve_tenants", len(works))
-        return [w.result for w in works]
+        results = [w.result for w in works]
+        await self._publish_cycle(results, time.perf_counter() - t0)
+        return results
+
+    async def _publish_cycle(self, results, wall_s: float) -> None:
+        """Post-cycle telemetry: the cycle summary (tenant paths, wall,
+        per-tenant seal-latency SLO burn) goes to the live /healthz
+        endpoint and — when a sink is configured — into one
+        ``serve_cycle`` sink record; each sealed tenant's replication
+        status (sampled by its own ``_compact_seal``) feeds the live
+        health map.  Strictly after the fold/seal work, never on the
+        hot path, and never fatal to the cycle it describes."""
+        from ..obs import live as obs_live
+        from ..obs import sink as obs_sink
+        from ..obs import slo as obs_slo
+
+        try:
+            burn = obs_slo.cycle_burn(results)
+            paths: dict[str, int] = {}
+            for r in results:
+                paths[r.path] = paths.get(r.path, 0) + 1
+            summary = {
+                "tenants": len(results),
+                "sealed": sum(1 for r in results if r.sealed),
+                "errors": sum(1 for r in results if r.error is not None),
+                "paths": paths,
+                "wall_s": round(wall_s, 4),
+                "slo": burn,
+            }
+            self.last_cycle_summary = summary
+            trace.gauge("serve_slo_seal_burn", burn["burn_rate"])
+            target = self.live if self.live is not None \
+                else obs_live.default_server()
+            if target is not None:
+                target.publish_cycle("fold_service", summary)
+                # only tenants that SEALED this cycle republished a
+                # fresh replication sample (_compact_seal's sampler) —
+                # republishing a quiet/errored tenant's old status
+                # would stamp stale watermark data with a current ts,
+                # hiding exactly the wedged-replica staleness /healthz
+                # exists to expose
+                for core, r in zip(self.tenants, results):
+                    status = getattr(core, "last_replication_status", None)
+                    if r.sealed and status is not None:
+                        target.publish_health(status)
+            if obs_sink.default_sink() is not None:
+                await asyncio.to_thread(
+                    obs_sink.maybe_write, "serve_cycle", summary
+                )
+        except Exception:  # telemetry must not fail the fleet cycle
+            logger.debug("cycle telemetry publication failed",
+                         exc_info=True)
 
     # ------------------------------------------------------------ ingest
     async def _ingest_all(self, works) -> None:

@@ -12,6 +12,10 @@ Consumes the JSONL files the metrics sink writes (``obs.sink``, env
     python -m crdt_enc_tpu.tools.obs_report fleet DEV1.jsonl DEV2.jsonl ...
     python -m crdt_enc_tpu.tools.obs_report trend BENCH_LOCAL.jsonl \\
         [--metric M] [--fail-on-regression PCT]
+    python -m crdt_enc_tpu.tools.obs_report gap BENCH_LOCAL.jsonl \\
+        [--metric M]
+    python -m crdt_enc_tpu.tools.obs_report slo RUN.jsonl [--window S] \\
+        [--fail-on-burn]
 
 * **report** — the per-phase table (totals, counts, p50/p95/p99/max)
   plus counters and gauges for one record.
@@ -33,6 +37,15 @@ Consumes the JSONL files the metrics sink writes (``obs.sink``, env
   ``--fail-on-regression PCT`` exits 1 when any config's latest run is
   more than PCT percent below its best earlier run — the CI gate that
   makes perf regressions visible instead of living only in the JSONL.
+* **gap** — cycle attribution (``obs.attribution``): stage marginals
+  (decrypt/decode/h2d/fold/scatter/seal), overlap efficiency, the
+  critical-path stage, and the e2e-vs-fold-marginal gap ratio with the
+  dominant stage named.  Reads bench records (the ``obs`` snapshot +
+  wall/ops fields) and sink records alike.
+* **slo** — freshness/seal-latency SLO burn accounting
+  (``obs.slo``) over sink files: per-window violation fractions vs the
+  error budget; ``--fail-on-burn`` exits 1 when a spec's overall
+  budget burn exceeds 1.0×.
 
 Record selection: ``--label`` filters by snapshot label, ``--index``
 picks among matches (default -1, the newest).  Records without the
@@ -46,9 +59,11 @@ import argparse
 import json
 import sys
 
+from ..obs import attribution as obs_attribution
 from ..obs import fleet as obs_fleet
 from ..obs import record as obs_record
 from ..obs import sink as obs_sink
+from ..obs import slo as obs_slo
 from ..obs import timeline as obs_timeline
 
 # one parse for the file format, shared with obs.fleet (obs.sink owns it)
@@ -132,6 +147,80 @@ def cmd_trend(args) -> int:
             file=sys.stderr,
         )
         return 1
+    return 0
+
+
+def cmd_gap(args) -> int:
+    try:
+        records = load_records(args.file)
+        # refuse newer sink schemas loudly instead of attributing a
+        # format this build cannot read (same contract as slo/trend)
+        obs_sink.check_schema(records, source=args.file)
+    except (obs_sink.SinkSchemaError, OSError) as e:
+        print(e, file=sys.stderr)
+        return 2
+    if args.label is not None:
+        records = [r for r in records if r.get("label") == args.label]
+    if args.metric is not None:
+        records = [r for r in records if r.get("metric") == args.metric]
+    # attribution needs a snapshot: a bench record's "obs" or a sink
+    # record's top-level spans
+    records = [
+        r for r in records
+        if isinstance(r.get("obs"), dict) or "spans" in r
+    ]
+    if not records:
+        print(
+            f"no attributable records (label={args.label!r}, "
+            f"metric={args.metric!r}) — need an 'obs' snapshot or "
+            "top-level spans",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        rec = records[args.index]
+    except IndexError:
+        print(
+            f"index {args.index} out of range "
+            f"({len(records)} matching records)",
+            file=sys.stderr,
+        )
+        return 2
+    report = obs_attribution.from_record(rec)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(f"# {_fmt_label(rec) if 'label' in rec else rec.get('metric', '?')}")
+        print(obs_attribution.format_attribution(report))
+    return 0
+
+
+def cmd_slo(args) -> int:
+    records = []
+    try:
+        for path in args.files:
+            recs = load_records(path)
+            obs_sink.check_schema(recs, source=path)
+            records.extend(recs)
+    except (obs_sink.SinkSchemaError, OSError) as e:
+        print(e, file=sys.stderr)
+        return 2
+    report = obs_slo.burn_report(records, window_s=args.window)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(obs_slo.format_burn(report))
+    if args.fail_on_burn:
+        burning = [
+            s["name"] for s in report["specs"]
+            if s.get("budget_burn", 0.0) > 1.0
+        ]
+        if burning:
+            print(
+                f"SLO budget burn > 1.0x for: {', '.join(burning)}",
+                file=sys.stderr,
+            )
+            return 1
     return 0
 
 
@@ -244,6 +333,31 @@ def main(argv=None) -> int:
     )
     common(p)
     p.set_defaults(fn=cmd_prom)
+
+    p = sub.add_parser(
+        "gap",
+        help="cycle attribution + e2e-vs-fold-marginal gap report",
+    )
+    p.add_argument("file")
+    p.add_argument("--metric", help="filter bench records by metric")
+    p.add_argument("--json", action="store_true", help="machine output")
+    common(p)
+    p.set_defaults(fn=cmd_gap)
+
+    p = sub.add_parser(
+        "slo", help="SLO burn accounting over sink files"
+    )
+    p.add_argument("files", nargs="+", metavar="RUN.jsonl")
+    p.add_argument(
+        "--window", type=float, default=obs_slo.DEFAULT_WINDOW_S,
+        help="burn window in seconds (default %(default)s)",
+    )
+    p.add_argument(
+        "--fail-on-burn", action="store_true",
+        help="exit 1 when any spec's overall budget burn exceeds 1.0x",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser(
         "fleet", help="aggregate devices' sink files into one fleet report"

@@ -1,0 +1,179 @@
+"""Cycle attribution + gap report (ISSUE 11): exact stage math, nesting
+guards, overlap proof, and the CLI golden on the committed BENCH_LOCAL
+``--e2e-streaming`` record (the acceptance: the ratio and the dominant
+stage are machine-printed, byte-stable)."""
+
+import json
+import pathlib
+
+from crdt_enc_tpu.obs import attribution
+from crdt_enc_tpu.tools import obs_report
+
+DATA = pathlib.Path(__file__).parent / "data"
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def _snap(spans):
+    return {
+        "spans": {k: {"count": 1, "seconds": v} for k, v in spans.items()},
+        "counters": {},
+        "gauges": {},
+    }
+
+
+def test_streaming_stage_math_exact():
+    rep = attribution.attribute_cycle(
+        _snap({
+            "stream.decrypt": 2.0,
+            "stream.decode": 3.0,
+            # nested inside stream.decode — must NOT double count
+            "session.decode": 2.9,
+            "stream.reduce": 1.0,
+            "stream.finish": 0.5,
+        }),
+        wall_s=5.0, ops=1000,
+    )
+    assert rep["pipeline"] == "streaming"
+    assert rep["stages"]["decrypt"]["seconds"] == 2.0
+    assert rep["stages"]["decode"]["seconds"] == 3.0
+    assert rep["stages"]["decode"]["spans"] == {"stream.decode": 3.0}
+    assert rep["stages"]["fold"]["seconds"] == 1.0
+    assert rep["stages"]["scatter"]["seconds"] == 0.5
+    assert rep["serialized_s"] == 6.5
+    assert rep["overlap_x"] == 1.3  # 6.5 / 5.0 — the pipeline overlapped
+    assert rep["critical_path"] == "decode"
+    assert rep["gap"] == {
+        "ops": 1000,
+        "e2e_ops_per_sec": 200.0,
+        "fold_marginal_ops_per_sec": 1000.0,
+        "gap_x": 5.0,
+        "dominant_stage": "decode",
+    }
+
+
+def test_alternative_spans_when_stream_absent():
+    """A bulk (non-pipelined) run records ops.bulk_* instead of
+    stream.* — the stage groups fall through to them."""
+    rep = attribution.attribute_cycle(
+        _snap({"ops.bulk_decrypt": 1.0, "ops.bulk_fold": 4.0,
+               "compact.seal": 0.25, "compact.write": 0.25}),
+        wall_s=6.0, ops=600,
+    )
+    assert rep["stages"]["decrypt"]["seconds"] == 1.0
+    assert rep["stages"]["fold"]["seconds"] == 4.0
+    assert rep["stages"]["seal"]["seconds"] == 0.5  # disjoint groups sum
+    assert rep["critical_path"] == "fold"
+    assert rep["gap"]["fold_marginal_ops_per_sec"] == 150.0
+    assert rep["gap"]["gap_x"] == 1.5
+
+
+def test_serve_pipeline_detection_and_wall_inference():
+    rep = attribution.attribute_cycle(
+        _snap({"serve.cycle": 2.0, "serve.decrypt": 0.5,
+               "serve.fold": 0.2, "serve.seal": 1.0}),
+        ops=400,
+    )
+    assert rep["pipeline"] == "serve"
+    assert rep["wall_s"] == 2.0  # inferred from serve.cycle
+    assert rep["critical_path"] == "seal"
+    assert rep["gap"]["e2e_ops_per_sec"] == 200.0
+    assert rep["gap"]["dominant_stage"] == "seal"
+
+
+def test_no_ops_or_wall_degrades_gracefully():
+    rep = attribution.attribute_cycle(_snap({"stream.decrypt": 1.0}))
+    assert rep["wall_s"] is None
+    assert "gap" not in rep and "overlap_x" not in rep
+    assert rep["critical_path"] == "decrypt"
+    out = attribution.format_attribution(rep)
+    assert "critical path: decrypt" in out
+
+
+def test_events_give_wall_and_overlap_proof():
+    """chunk k+1's ingest starting inside chunk k's reduce = one
+    overlapped chunk, and the wall comes from the event extent."""
+    def ev(name, t0, t1, chunk):
+        return {"name": name, "kind": "span", "t0": t0, "t1": t1,
+                "meta": chunk, "tid": 1, "thread": "t"}
+
+    events = [
+        ev("stream.ingest", 0.0, 1.0, 0),
+        ev("stream.reduce", 1.0, 2.0, 0),
+        ev("stream.ingest", 1.5, 2.5, 1),  # overlaps chunk 0's reduce
+        ev("stream.reduce", 2.5, 3.5, 1),
+    ]
+    rep = attribution.attribute_cycle(
+        _snap({"stream.reduce": 2.0}), events=events, ops=100,
+    )
+    assert rep["wall_s"] == 3.5
+    assert rep["overlapped_chunks"] == 1
+    assert rep["gap"]["fold_marginal_ops_per_sec"] == 50.0
+
+
+def test_from_record_bench_and_sink_shapes():
+    bench = {
+        "metric": "orset_e2e_streaming_ops_per_sec",
+        "e2e_overlapped_s": 2.0,
+        "shape": {"total_ops": 1000},
+        "obs": _snap({"stream.decrypt": 1.5, "stream.reduce": 0.1}),
+    }
+    rep = attribution.from_record(bench)
+    assert rep["gap"]["e2e_ops_per_sec"] == 500.0
+    assert rep["gap"]["gap_x"] == 20.0
+    assert rep["critical_path"] == "decrypt"
+
+    sink_rec = {
+        "schema": 2, "label": "compact", "ts": 1.0,
+        **_snap({"serve.cycle": 1.0, "serve.fold": 0.5}),
+        "counters": {"serve_rows_folded": 50},
+    }
+    rep = attribution.from_record(sink_rec)
+    assert rep["pipeline"] == "serve"
+    assert rep["gap"]["ops"] == 50
+    assert rep["gap"]["e2e_ops_per_sec"] == 50.0
+
+
+# ---- the CLI + the committed-record golden --------------------------------
+
+
+def test_cli_gap_golden_on_committed_streaming_record(capsys):
+    """The acceptance gate: `obs_report gap` on the committed
+    BENCH_LOCAL --e2e-streaming record prints the e2e-vs-fold-marginal
+    ratio and names the dominant stage, byte-identical to the
+    committed golden."""
+    assert obs_report.main([
+        "gap", str(REPO / "BENCH_LOCAL.jsonl"),
+        "--metric", "orset_e2e_streaming_ops_per_sec",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert out == (DATA / "obs_gap_golden.txt").read_text()
+    # the two headline facts, asserted independently of the rendering
+    assert "= 10.65x" in out
+    assert "dominant stage: decode" in out
+
+
+def test_cli_gap_serve_record_and_json(capsys):
+    assert obs_report.main([
+        "gap", str(REPO / "BENCH_LOCAL.jsonl"),
+        "--metric", "orset_multitenant_agg_ops_per_sec", "--json",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["pipeline"] == "serve"
+    assert rep["gap"]["dominant_stage"] == rep["critical_path"]
+
+
+def test_cli_gap_no_attributable_records(tmp_path, capsys):
+    p = tmp_path / "empty.jsonl"
+    p.write_text(json.dumps({"metric": "x", "value": 1.0}) + "\n")
+    assert obs_report.main(["gap", str(p)]) == 2
+    assert "no attributable records" in capsys.readouterr().err
+
+
+def test_cli_gap_rejects_unreadable_schema(tmp_path, capsys):
+    """gap shares slo/trend's schema contract: refuse a future sink
+    format loudly instead of misattributing it."""
+    p = tmp_path / "future.jsonl"
+    p.write_text(json.dumps({"schema": 99, "label": "compact",
+                             "spans": {}}) + "\n")
+    assert obs_report.main(["gap", str(p)]) == 2
+    assert "schema" in capsys.readouterr().err

@@ -546,3 +546,107 @@ def test_obs_report_export_trace_requires_events(tmp_path, capsys):
 # static-analysis engine (rules SPN001/THR001); the tier-1 gate is now
 # tests/test_static_analysis.py::test_live_repo_analysis_clean_within_budget
 # (plus the shim exit-code tests there).
+
+
+# ---- prometheus text-format escaping (ISSUE 11 satellite) -----------------
+
+
+def test_prometheus_label_value_escaping_roundtrip():
+    """Label values escape backslash, double-quote and newline per the
+    text-format spec; a spec-compliant unescape recovers the original
+    span name exactly."""
+    weird = 'sp"an\\x\nend'
+    snap = {
+        "spans": {weird: {"count": 1, "seconds": 0.5}},
+        "counters": {},
+        "gauges": {},
+    }
+    prom = sink.to_prometheus(snap)
+    line = next(
+        ln for ln in prom.splitlines()
+        if ln.startswith("crdt_span_count_total{")
+    )
+    # the rendered line is ONE physical line (the newline was escaped)
+    assert "\n" not in line
+    rendered = line[len('crdt_span_count_total{span="'):line.rindex('"')]
+    assert rendered == 'sp\\"an\\\\x\\nend'
+    unescaped = (
+        rendered.replace("\\\\", "\x00").replace('\\"', '"')
+        .replace("\\n", "\n").replace("\x00", "\\")
+    )
+    assert unescaped == weird
+
+
+def test_prometheus_help_escaping(monkeypatch):
+    """HELP text escapes backslash and newline (only those two, per the
+    spec) — both for registry-derived and fallback help strings."""
+    monkeypatch.setattr(
+        sink, "registry_help", lambda: {"ops_folded": "a\\b\nc"}
+    )
+    snap = {"spans": {}, "counters": {"ops_folded": 1}, "gauges": {}}
+    prom = sink.to_prometheus(snap)
+    assert "# HELP crdt_ops_folded_total a\\\\b\\nc" in prom
+    # fallback help for an unregistered name is escaped the same way
+    snap = {"spans": {}, "counters": {}, "gauges": {"we\\ird": 1}}
+    prom = sink.to_prometheus(snap)
+    help_line = next(
+        ln for ln in prom.splitlines() if ln.startswith("# HELP")
+    )
+    assert "we\\\\ird" in help_line
+
+
+def test_prometheus_registry_help_single_escape():
+    """The registry parse keeps raw text; escaping happens once at
+    render time (a doc description containing a backslash must not
+    double-escape)."""
+    sink._help_cache = None
+    try:
+        help_ = sink.registry_help()
+        # live-repo registry descriptions never pre-escape
+        assert all("\\\\" not in v for v in help_.values())
+    finally:
+        sink._help_cache = None
+
+
+def test_sink_rotation_concurrent_writers(tmp_path, monkeypatch):
+    """N threads writing through CRDT_OBS_SINK_MAX_MB rotation: the
+    size bound holds, every record lands in EXACTLY one generation
+    (the limit allows at most one rotation for this workload — nothing
+    is lost, nothing duplicated), and every surviving record parses
+    under check_schema."""
+    import threading
+
+    path = tmp_path / "rot.jsonl"
+    s = sink.MetricsSink(str(path))
+    probe = len(json.dumps(s.write("probe-00"))) + 1
+    n_threads, per_thread = 8, 6
+    total = n_threads * per_thread + 1  # +1 for the probe record
+    limit = probe * total  # > half the volume → at most ONE rotation
+    monkeypatch.setenv("CRDT_OBS_SINK_MAX_MB", str(limit / 1e6))
+
+    barrier = threading.Barrier(n_threads)
+
+    def writer(i):
+        barrier.wait()
+        for k in range(per_thread):
+            s.write(f"w-{i:03d}-{k:02d}")
+
+    threads = [
+        threading.Thread(target=writer, args=(i,))
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    labels = []
+    for p in (path, tmp_path / "rot.jsonl.1"):
+        if not p.exists():
+            continue
+        assert p.stat().st_size <= limit  # the bound held per generation
+        records = sink.read_records(str(p))
+        sink.check_schema(records, source=str(p))
+        labels.extend(r["label"] for r in records)
+    assert len(labels) == total  # nothing lost
+    assert len(set(labels)) == total  # nothing written twice

@@ -1,0 +1,31 @@
+"""pin_baselines spread gate (ISSUE 11 satellite / VERDICT item 4): a
+pin measured with >30% host sample spread is refused unless forced."""
+
+from benchmarks.pin_baselines import SPREAD_LIMIT_PCT, spread_gate
+
+
+def test_spread_limit_is_thirty():
+    assert SPREAD_LIMIT_PCT == 30.0
+
+
+def test_within_limit_passes_silently(capsys):
+    assert spread_gate("cfg3", {"host_spread_pct": 12.4}) is True
+    assert spread_gate("cfg3", {"host_spread_pct": 30.0}) is True
+    # legacy records without the field are not retroactively refused
+    assert spread_gate("cfg3", {}) is True
+    assert capsys.readouterr().err == ""
+
+
+def test_over_limit_refused_with_reason(capsys):
+    assert spread_gate("cfg5", {"host_spread_pct": 31.0}) is False
+    err = capsys.readouterr().err
+    assert "REFUSING to pin cfg5" in err
+    assert "31.0 > 30" in err
+    assert "--force" in err
+
+
+def test_force_overrides_with_warning(capsys):
+    assert spread_gate("cfg5", {"host_spread_pct": 55.5}, force=True) \
+        is True
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "55.5" in err

@@ -630,11 +630,15 @@ def test_fleet_cli_end_to_end_two_real_devices(tmp_path, capsys,
     assert "no record carries" in capsys.readouterr().err
 
 
-def test_fleet_golden(capsys):
+def test_fleet_golden(capsys, monkeypatch):
     """The committed fixture files render byte-identically to the
-    committed golden — the same diff tools/run_checks.sh runs."""
+    committed golden — the same diff tools/run_checks.sh runs (both
+    pin the default SLO config: the SLO column deliberately follows
+    CRDT_SLO_*, so the golden must not inherit ambient env)."""
     from crdt_enc_tpu.tools import obs_report
 
+    monkeypatch.delenv("CRDT_SLO_FRESHNESS_LAG", raising=False)
+    monkeypatch.delenv("CRDT_SLO_OBJECTIVE", raising=False)
     assert obs_report.main([
         "fleet",
         str(DATA / "fleet_device_a.jsonl"),
@@ -682,6 +686,32 @@ def test_bench_trend_trajectory_and_regressions():
     # metric filter narrows the table
     only = fleet.bench_trend(records, metric="merge")
     assert [c["metric"] for c in only] == ["merge"]
+
+
+def test_bench_trend_shapeless_records_key_by_config():
+    """Shapeless records (the sim bench) fall back to their config
+    string — a 4r×50s and an 8r×250s sim run are different workloads
+    and must not collapse into one regression trajectory (the ISSUE-11
+    ratchet would otherwise compare apples to oranges)."""
+    records = [
+        {"metric": "sim_schedules_per_sec", "value": 1.3, "ts": "t1",
+         "backend": "cpu", "config": "sim_4r_50s_all"},
+        {"metric": "sim_schedules_per_sec", "value": 0.5, "ts": "t2",
+         "backend": "cpu", "config": "sim_8r_250s_all"},
+    ]
+    trend = fleet.bench_trend(records)
+    assert len(trend) == 2
+    assert sorted(c["shape"]["config"] for c in trend) == [
+        "sim_4r_50s_all", "sim_8r_250s_all",
+    ]
+    # one run each → no trajectory, no false regression
+    assert fleet.trend_regressions(trend, 10) == []
+    # the committed BENCH_LOCAL passes the run_checks.sh ratchet at 45%
+    repo_records = sink.read_records(
+        str(pathlib.Path(__file__).parent.parent / "BENCH_LOCAL.jsonl")
+    )
+    repo_trend = fleet.bench_trend(repo_records)
+    assert fleet.trend_regressions(repo_trend, 45) == []
 
 
 def test_trend_cli_fail_on_regression(tmp_path, capsys):

@@ -1,0 +1,228 @@
+"""Freshness SLOs (ISSUE 11): spec resolution, live gauges, exact
+window burn accounting, FoldService cycle burn, the obs_report slo CLI,
+and the fleet report's SLO column."""
+
+import json
+import pathlib
+
+import pytest
+
+from crdt_enc_tpu.obs import fleet, record, slo
+from crdt_enc_tpu.tools import obs_report
+
+DATA = pathlib.Path(__file__).parent / "data"
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (slo.ENV_FRESHNESS, slo.ENV_SEAL, slo.ENV_OBJECTIVE):
+        monkeypatch.delenv(var, raising=False)
+    record.reset()
+    yield
+    record.reset()
+
+
+def _rep(wm_lag):
+    return {"divergence": {"watermark_lag": wm_lag}}
+
+
+def _freshness_rec(ts, wm_lag):
+    return {"schema": 2, "label": "compact", "ts": ts,
+            "spans": {}, "counters": {}, "gauges": {},
+            "replication": {"divergence": {"watermark_lag": wm_lag}}}
+
+
+def _cycle_rec(ts, attempts, violations):
+    return {"schema": 2, "label": "serve_cycle", "ts": ts,
+            "spans": {}, "counters": {}, "gauges": {},
+            "meta": {"slo": {"attempts": attempts,
+                             "violations": violations}}}
+
+
+# ---- specs ----------------------------------------------------------------
+
+
+def test_spec_defaults_and_env_overrides(monkeypatch):
+    f = slo.freshness_spec()
+    assert (f.target, f.objective) == (64.0, 0.99)
+    assert slo.seal_latency_spec().target == 2.0
+    monkeypatch.setenv(slo.ENV_FRESHNESS, "8")
+    monkeypatch.setenv(slo.ENV_SEAL, "0.5")
+    monkeypatch.setenv(slo.ENV_OBJECTIVE, "0.9")
+    assert slo.freshness_spec().target == 8.0
+    assert slo.freshness_spec().objective == 0.9
+    assert slo.seal_latency_spec().target == 0.5
+    # garbage / out-of-range values fall back, never raise
+    monkeypatch.setenv(slo.ENV_FRESHNESS, "banana")
+    monkeypatch.setenv(slo.ENV_OBJECTIVE, "7")
+    assert slo.freshness_spec().target == 64.0
+    assert slo.freshness_spec().objective == 0.99
+    # a 1.0 objective cannot zero-divide the budget
+    assert slo.SloSpec("x", "i", 1.0, objective=1.0).budget > 0
+
+
+def test_sample_freshness_gauges(monkeypatch):
+    monkeypatch.setenv(slo.ENV_FRESHNESS, "10")
+    assert slo.sample_freshness(_rep(10)) is True
+    g = record.snapshot()["gauges"]
+    assert g["repl_slo_freshness_ok"] == 1.0
+    assert g["repl_slo_freshness_target"] == 10.0
+    assert slo.sample_freshness(_rep(11)) is False
+    assert record.snapshot()["gauges"]["repl_slo_freshness_ok"] == 0.0
+
+
+# ---- burn accounting ------------------------------------------------------
+
+
+def test_burn_report_windows_exact(monkeypatch):
+    monkeypatch.setenv(slo.ENV_FRESHNESS, "5")
+    # window 0 (t=0..99): 4 samples, 1 violation; window 1: empty;
+    # window 2 (t=200..): 2 samples, 2 violations
+    records = [
+        _freshness_rec(1000.0, 0),
+        _freshness_rec(1010.0, 5),    # at target = ok
+        _freshness_rec(1020.0, 6),    # violation
+        _freshness_rec(1099.0, 1),
+        _freshness_rec(1200.0, 50),   # violation
+        _freshness_rec(1250.0, 500),  # violation
+    ]
+    rep = slo.burn_report(records, window_s=100.0)
+    [fresh, seal] = rep["specs"]
+    assert fresh["name"] == "freshness"
+    assert fresh["samples"] == 6 and fresh["violations"] == 3
+    assert fresh["bad_fraction"] == 0.5
+    assert fresh["budget_burn"] == 50.0  # 0.5 / 0.01
+    assert fresh["windows"] == [
+        {"window": 0, "start_s": 0.0, "samples": 4, "violations": 1,
+         "burn_rate": 25.0},
+        {"window": 2, "start_s": 200.0, "samples": 2, "violations": 2,
+         "burn_rate": 100.0},
+    ]
+    assert fresh["worst_window_burn"] == 100.0
+    # no FoldService ran: zero seal-latency samples, not compliance
+    assert seal["name"] == "seal_latency"
+    assert seal["samples"] == 0 and seal["windows"] == []
+    out = slo.format_burn(rep)
+    assert "budget burn 50.00x" in out
+    assert "(no samples)" in out
+
+
+def test_burn_report_seal_latency_from_cycle_records():
+    records = [
+        _cycle_rec(0.0, 10, 0),
+        _cycle_rec(10.0, 10, 2),
+    ]
+    rep = slo.burn_report(records, window_s=300.0)
+    seal = rep["specs"][1]
+    assert seal["samples"] == 20 and seal["violations"] == 2
+    assert seal["bad_fraction"] == 0.1
+    assert seal["budget_burn"] == 10.0
+    assert seal["windows"] == [
+        {"window": 0, "start_s": 0.0, "samples": 20, "violations": 2,
+         "burn_rate": 10.0},
+    ]
+
+
+def test_cycle_burn(monkeypatch):
+    class R:
+        def __init__(self, sealed, latency_s):
+            self.sealed = sealed
+            self.latency_s = latency_s
+            self.error = None
+
+    monkeypatch.setenv(slo.ENV_SEAL, "1.0")
+    burn = slo.cycle_burn([R(True, 0.5), R(True, 1.5), R(False, 9.0)])
+    assert burn["tenants"] == 3 and burn["sealed"] == 2
+    assert burn["attempts"] == 2  # the skipped tenant was no attempt
+    assert burn["violations"] == 1
+    assert burn["burn_rate"] == 50.0  # (1/2) / 0.01
+    assert slo.cycle_burn([])["burn_rate"] == 0.0
+
+
+def test_cycle_burn_errored_tenants_are_violations(monkeypatch):
+    """A seal that never happened is infinitely late: a total outage
+    (every tenant errors) must burn at the maximum rate, never render
+    as a green zero-sealed/zero-violation cycle."""
+    class R:
+        def __init__(self, sealed=False, latency_s=0.0, error=None):
+            self.sealed = sealed
+            self.latency_s = latency_s
+            self.error = error
+
+    monkeypatch.setenv(slo.ENV_SEAL, "1.0")
+    burn = slo.cycle_burn([R(error="boom"), R(error="boom")])
+    assert burn["sealed"] == 0 and burn["errors"] == 2
+    assert burn["attempts"] == 2 and burn["violations"] == 2
+    assert burn["burn_rate"] == 100.0  # (2/2) / 0.01 — max burn
+    # mixed: one fast seal, one error → half the attempts violated
+    burn = slo.cycle_burn([R(sealed=True, latency_s=0.1),
+                           R(error="boom")])
+    assert burn["attempts"] == 2 and burn["violations"] == 1
+    assert burn["burn_rate"] == 50.0
+
+
+# ---- CLI ------------------------------------------------------------------
+
+
+def test_cli_slo_and_fail_on_burn(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv(slo.ENV_FRESHNESS, "5")
+    p = tmp_path / "run.jsonl"
+    p.write_text("".join(
+        json.dumps(r) + "\n" for r in
+        [_freshness_rec(0.0, 0), _freshness_rec(1.0, 100)]
+    ))
+    assert obs_report.main(["slo", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "freshness: target <= 5" in out
+    assert "budget burn 50.00x" in out
+    # --fail-on-burn turns the over-budget spec into exit 1
+    assert obs_report.main(["slo", str(p), "--fail-on-burn"]) == 1
+    assert "freshness" in capsys.readouterr().err
+    # --json round-trips
+    assert obs_report.main(["slo", str(p), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["specs"][0]["budget_burn"] == 50.0
+    # unreadable schema fails loudly with exit 2
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"schema": 99, "label": "x"}) + "\n")
+    assert obs_report.main(["slo", str(bad)]) == 2
+
+
+# ---- fleet SLO column -----------------------------------------------------
+
+
+def _dev_record(actor_hex, wm_lag, ts=100.0):
+    return {
+        "schema": 2, "label": "compact", "ts": ts,
+        "spans": {}, "counters": {}, "gauges": {},
+        "replication": {
+            "actor": actor_hex,
+            "remote_id": "99" * 32,
+            "local_clock": {actor_hex: 1},
+            "union_clock": {actor_hex: 1},
+            "watermark": {}, "matrix": {},
+            "backlog": {"files": 0, "bytes": 0, "per_actor": {}},
+            "divergence": {"actors_behind": 0, "version_lag": 0,
+                           "watermark_lag": wm_lag, "known_replicas": 1},
+            "checkpoint": {"enabled": False, "sealed": False,
+                           "staleness_versions": 0},
+        },
+    }
+
+
+def test_fleet_report_slo_column(tmp_path, monkeypatch):
+    monkeypatch.setenv(slo.ENV_FRESHNESS, "10")
+    paths = []
+    for i, lag in enumerate((0, 99)):
+        p = tmp_path / f"d{i}.jsonl"
+        p.write_text(json.dumps(_dev_record(f"{i:02x}" * 16, lag)) + "\n")
+        paths.append(str(p))
+    report = fleet.fleet_report(fleet.device_summaries(paths))
+    [r] = report["remotes"]
+    assert r["slo"] == {
+        "freshness_target": 10.0, "devices_ok": 1, "devices_burning": 1,
+    }
+    assert [d["slo_ok"] for d in r["devices"]] == [True, False]
+    out = fleet.format_fleet(report)
+    assert "slo freshness (lag<=10): 1 ok, 1 burning" in out
+    assert "slo=ok" in out and "slo=BURN" in out

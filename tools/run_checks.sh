@@ -41,10 +41,21 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_delta.py -q \
     -p no:cacheprovider -k "differential or rides_device_kernels"
 
 echo "== obs_report fleet golden =="
-python -m crdt_enc_tpu.tools.obs_report fleet \
+# the SLO column follows the active CRDT_SLO_* config by design — pin
+# the defaults here so the golden diff is environment-insensitive
+env -u CRDT_SLO_FRESHNESS_LAG -u CRDT_SLO_OBJECTIVE \
+    python -m crdt_enc_tpu.tools.obs_report fleet \
     tests/data/fleet_device_a.jsonl tests/data/fleet_device_b.jsonl \
     | diff -u tests/data/obs_fleet_golden.txt - \
     || { echo "fleet rendering drifted from tests/data/obs_fleet_golden.txt"; exit 1; }
+
+echo "== perf trend ratchet (BENCH_LOCAL) =="
+# nightly perf ratchet (ROADMAP item 5): a config whose latest run
+# dropped >45% below its prior best fails the build.  45% tolerates
+# the documented ±30% shared-box swing (docs/multitenant.md) while
+# catching real order-of-magnitude regressions.
+python -m crdt_enc_tpu.tools.obs_report trend BENCH_LOCAL.jsonl \
+    --fail-on-regression 45
 
 echo "== parity count =="
 python - <<'EOF'
