@@ -870,6 +870,348 @@ def e2e_multitenant(smoke: bool):
     })
 
 
+def _daemon_fleet_shape(smoke: bool):
+    """The --e2e-daemon workload shape (env knobs BENCH_DMN_*): T
+    single-remote tenants of N config-3-shaped ops in OPF-op encrypted
+    files — the many-small-tenants fleet of docs/multitenant.md, plus a
+    churn script (joiners, leavers, bursters) sized off T."""
+    T = _tenants_arg(int(os.environ.get(
+        "BENCH_DMN_TENANTS", 16 if smoke else 256)))
+    N = int(os.environ.get("BENCH_DMN_OPS", 96 if smoke else 256))
+    R = int(os.environ.get("BENCH_DMN_REPLICAS", 4))
+    E = int(os.environ.get("BENCH_DMN_MEMBERS", 64))
+    OPF = int(os.environ.get("BENCH_DMN_OPF", 24))
+    CYCLES = int(os.environ.get("BENCH_DMN_CYCLES", 4 if smoke else 6))
+    return T, N, R, E, OPF, CYCLES
+
+
+def _daemon_tenant_files(N, R, E, OPF, seed):
+    """One tenant's (actor, version, ops) file stream — the
+    e2e-multitenant generator shape, shared by the daemon bench and its
+    pinned host baseline."""
+    from benchmarks.suite import actor_bytes_table
+
+    actors = actor_bytes_table(R)
+    kind, member, actor, counter = gen_columns(N, R, E, seed=seed)
+    live = actor < R
+    order = np.argsort(actor[live], kind="stable")
+    k_l, m_l = kind[live][order], member[live][order]
+    a_l, c_l = actor[live][order], counter[live][order]
+    i, n = 0, len(k_l)
+    versions: dict = {}
+    out = []
+    while i < n:
+        j = min(i + OPF, n)
+        j = i + int(np.searchsorted(a_l[i:j], a_l[i], side="right"))
+        ab = actors[int(a_l[i])]
+        ops = []
+        for t in range(i, j):
+            if k_l[t] == 0:
+                ops.append([0, int(m_l[t]), [ab, int(c_l[t])]])
+            else:
+                ops.append([1, int(m_l[t]), {ab: int(c_l[t])}])
+        v = versions.get(ab, 0) + 1
+        versions[ab] = v
+        out.append((ab, v, ops))
+        i = j
+    return out
+
+
+async def _daemon_build_remotes(opts_fn, n_tenants, N, R, E, OPF, seed0):
+    """``n_tenants`` pristine encrypted remotes + per-tenant head op
+    counts; burst tails are returned PRE-SEALED so churn can drop them
+    into a live tenant's storage mid-run."""
+    import math
+
+    from crdt_enc_tpu.backends import MemoryRemote, MemoryStorage
+    from crdt_enc_tpu.core import Core
+
+    remotes, bursts, head_ops = [], [], []
+    for t in range(n_tenants):
+        files = _daemon_tenant_files(N, R, E, OPF, seed=seed0 + t)
+        n_tail = max(1, math.ceil(len(files) * 0.1))
+        head, tail = files[:-n_tail], files[-n_tail:]
+        remote = MemoryRemote()
+        writer = await Core.open(opts_fn(MemoryStorage(remote)))
+        for ab, v, ops in head:
+            blob = await writer._seal(ops)
+            await writer.storage.store_ops(ab, v, blob)
+        head_ops.append(sum(len(ops) for _, _, ops in head))
+        bursts.append([
+            (ab, v, await writer._seal(ops), len(ops))
+            for ab, v, ops in tail
+        ])
+        remotes.append(remote)
+    return remotes, bursts, head_ops
+
+
+def _daemon_opts_fn():
+    from crdt_enc_tpu.backends import (
+        PlainKeyCryptor, XChaChaCryptor,
+    )
+    from crdt_enc_tpu.core import OpenOptions, orset_adapter
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    def opts(storage):
+        return OpenOptions(
+            storage=storage,
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+            accelerator=TpuAccelerator(),
+        )
+    return opts
+
+
+def e2e_daemon_host(runs: int = 0):
+    """Pinned host baseline for the daemon family (pin_baselines.py
+    config 6): sequential solo ``Core.compact()`` over the default
+    daemon fleet's HEAD shape (no churn — the pin is the steady-state
+    denominator), median-of-N on fresh fleet copies per pass."""
+    import asyncio
+    import copy
+
+    T, N, R, E, OPF, _ = _daemon_fleet_shape(smoke=False)
+    opts = _daemon_opts_fn()
+
+    async def build():
+        return await _daemon_build_remotes(opts, T, N, R, E, OPF, 500)
+
+    remotes, _bursts, head_ops = asyncio.run(build())
+    total_ops = sum(head_ops)
+
+    def run_once():
+        async def one():
+            from crdt_enc_tpu.backends import MemoryStorage
+            from crdt_enc_tpu.core import Core
+
+            cores = [
+                await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+                for r in remotes
+            ]
+            t0 = time.perf_counter()
+            for c in cores:
+                await c.compact()
+            return time.perf_counter() - t0
+
+        return asyncio.run(one()), None
+
+    median_s, times, _ = host_median(run_once, runs)
+    return {
+        "config": f"daemon_{T}t",
+        "host_rate": total_ops / median_s,
+        "n_ops": total_ops,
+        "shape": {"tenants": T, "ops_per_tenant": N, "replicas": R,
+                  "members": E, "ops_per_file": OPF},
+        "median_s": median_s,
+        **host_stats(times),
+    }
+
+
+def e2e_daemon(smoke: bool):
+    """ISSUE-12 acceptance: the always-on FleetDaemon under churn.
+
+    T encrypted single-remote tenants are admitted into a
+    :class:`~crdt_enc_tpu.serve.FleetDaemon` (staleness-driven
+    scheduling: compaction is backlog-triggered, quiet tenants are
+    stat-polled) and the daemon runs CYCLES supervised cycles while the
+    fleet churns — T/8 tenants JOIN mid-run (admission), T/4 receive a
+    ~10% op-tail BURST, T/8 are EVICTED with a final checkpoint.  The
+    record is aggregate ops/s over the cycle loop, p99 freshness lag
+    (the ``watermark_lag`` samples the scheduler itself consumed), and
+    p99 per-tenant seal latency.  After the drain, every tenant's
+    remote — including evicted ones — is refolded by a fresh solo
+    ``Core.compact()`` on a copy; ANY byte divergence refuses the
+    record (the standard e2e evidence guard).
+
+    Env knobs: BENCH_DMN_TENANTS (256; --tenants N overrides),
+    BENCH_DMN_OPS (256/tenant), BENCH_DMN_REPLICAS (4),
+    BENCH_DMN_MEMBERS (64), BENCH_DMN_OPF (24), BENCH_DMN_CYCLES (6).
+    """
+    import asyncio
+    import copy
+
+    T, N, R, E, OPF, CYCLES = _daemon_fleet_shape(smoke)
+    # T=1 evicts nobody: the burst target and the evictee would be the
+    # same tenant, and an evictee with a fresh unfolded burst is stale
+    # by construction — not a divergence the guard should compare
+    JOIN, BURST = max(1, T // 8), max(1, T // 4)
+    LEAVE = 0 if T == 1 else max(1, T // 8)
+
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    first_platform = platforms.split(",")[0].strip() if platforms else ""
+    want_tpu = first_platform not in ("cpu",) and not smoke
+    jax, dev = acquire_jax(want_tpu)
+
+    import crdt_enc_tpu
+    from crdt_enc_tpu.backends import MemoryStorage
+    from crdt_enc_tpu.core import Core
+    from crdt_enc_tpu.models import canonical_bytes
+    from crdt_enc_tpu.serve import DaemonConfig, FleetDaemon, ServeConfig
+    from crdt_enc_tpu.utils import trace
+
+    crdt_enc_tpu.enable_compilation_cache()
+    opts = _daemon_opts_fn()
+
+    async def scenario():
+        remotes, bursts, head_ops = await _daemon_build_remotes(
+            opts, T + JOIN, N, R, E, OPF, 500
+        )
+        log(
+            f"e2e_daemon: device {dev.platform}; {T} tenants "
+            f"(+{JOIN} join, -{LEAVE} evict, {BURST} burst), "
+            f"{sum(head_ops[:T])} head ops"
+        )
+        cores = [
+            await Core.open(opts(MemoryStorage(r))) for r in remotes[:T]
+        ]
+        cfg = DaemonConfig(
+            interval_s=0.0, batch=T + JOIN,
+            min_backlog_files=1, max_idle_cycles=CYCLES + 10,
+            # admission sized to the fleet the scenario intends to
+            # admit: the default warm-budget gate at the pre-
+            # observation 1MiB/tenant estimate would refuse joiners
+            # past 256 tenants (the operator's knob, set like one)
+            admission_bytes=(T + JOIN + 1) << 20,
+            serve=ServeConfig(seal_empty=False),
+        )
+        daemon = FleetDaemon(cores, cfg, seed=7)
+
+        # warmup compiles on a throwaway copy fleet (repo protocol)
+        warm = [
+            await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+            for r in remotes[: min(8, T)]
+        ]
+        await daemon.service.run_cycle(warm)
+        del warm
+
+        total_ops = sum(head_ops[:T])
+        seal_lat: list = []
+        fresh_lag: list = []
+        churn = {"joined": 0, "evicted": 0, "burst_tenants": 0,
+                 "burst_ops": 0}
+        trace.reset()
+        t0 = time.perf_counter()
+        for c in range(CYCLES):
+            if c == 1:  # joiners: admission while running
+                for j in range(JOIN):
+                    core = await Core.open(
+                        opts(MemoryStorage(remotes[T + j]))
+                    )
+                    await daemon.admit(core)
+                    cores.append(core)
+                    total_ops += head_ops[T + j]
+                    churn["joined"] += 1
+            if c == 2:  # burst: op tails land on live tenants
+                for t in range(BURST):
+                    # distinct targets past the future evictees (wraps
+                    # only at T=1, where BURST is also 1)
+                    idx = (LEAVE + t) % T
+                    core = cores[idx]
+                    for ab, v, blob, n_ops in bursts[idx]:
+                        await core.storage.store_ops(ab, v, blob)
+                        total_ops += n_ops
+                        churn["burst_ops"] += n_ops
+                    churn["burst_tenants"] += 1
+            if c == 3:  # leavers: eviction with a final checkpoint
+                for t in range(LEAVE):
+                    await daemon.evict(f"t{t}")
+                    churn["evicted"] += 1
+            report = await daemon.run_cycle()
+            for res in report["results"].values():
+                if res.get("latency_s") is not None:
+                    seal_lat.append(res["latency_s"])
+            for tid in daemon.tenant_ids:
+                status = daemon.entry(tid).status()
+                if status is not None:
+                    fresh_lag.append(
+                        float(status["divergence"]["watermark_lag"])
+                    )
+        wall = time.perf_counter() - t0
+        obs = trace.snapshot()
+        await daemon.drain()
+
+        # the no-divergence guard: EVERY tenant's remote (evicted ones
+        # included) must refold solo to the daemon tenant's final state
+        diverged = []
+        for i, core in enumerate(cores):
+            solo = await Core.open(
+                opts(MemoryStorage(copy.deepcopy(remotes[i])))
+            )
+            await solo.compact()
+            if solo.with_state(canonical_bytes) != core.with_state(
+                canonical_bytes
+            ):
+                diverged.append(i)
+        return (
+            wall, total_ops, seal_lat, fresh_lag, churn, obs, diverged,
+            daemon.health(),
+        )
+
+    (wall, total_ops, seal_lat, fresh_lag, churn, obs, diverged,
+     health) = asyncio.run(scenario())
+
+    rate = total_ops / wall
+    # freshness lag is in VERSIONS (not a latency) — exact nearest-rank
+    import math
+
+    def q(vals, frac):
+        s = sorted(vals)
+        return s[min(len(s) - 1, max(0, math.ceil(frac * len(s)) - 1))]
+
+    result = {
+        "metric": "daemon_e2e_agg_ops_per_sec",
+        "config": f"daemon_{T}t",
+        "value": round(rate, 1),
+        "unit": "ops/s",
+        "cycles": CYCLES,
+        "wall_s": round(wall, 4),
+        "total_ops": total_ops,
+        "seal_latency": _quantiles_ms(seal_lat) if seal_lat else {},
+        "freshness_lag_versions": {
+            "p50": q(fresh_lag, 0.50), "p99": q(fresh_lag, 0.99),
+            "max": max(fresh_lag),
+        } if fresh_lag else {},
+        "churn": churn,
+        "daemon": {k: health[k] for k in
+                   ("cycles", "tenants", "quarantined", "degraded")},
+        "byte_identical": not diverged,
+        "backend": dev.platform,
+    }
+    pin_shape = {"tenants": T, "ops_per_tenant": N, "replicas": R,
+                 "members": E, "ops_per_file": OPF}
+    pin = load_pinned(f"daemon_{T}t", pin_shape)
+    if pin:
+        result["vs_pinned_baseline"] = round(rate / pin["host_rate"], 2)
+        result["pinned_host_rate"] = pin["host_rate"]
+        result["vs_baseline"] = result["vs_pinned_baseline"]
+    print(json.dumps(result))
+    if diverged:
+        log(
+            f"FAILED: tenants {diverged[:5]} diverged from solo "
+            "compact() — refusing to record"
+        )
+        raise SystemExit(1)
+    if os.environ.get("BENCH_LOCAL_DISABLE") == "1":
+        return
+    if dev.platform != "tpu" and os.environ.get("BENCH_LOCAL_ALL") != "1":
+        return
+    _append_local({
+        **result,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "device_kind": dev.device_kind,
+        "host_cpus": os.cpu_count(),
+        "shape": {**pin_shape, "cycles": CYCLES, "join": JOIN,
+                  "leave": LEAVE, "burst": BURST},
+        "obs": obs,
+    })
+
+
 def e2e_warm_open(smoke: bool):
     """ISSUE-4 acceptance: cold open vs checkpointed (warm) open of a
     config-5-shaped un-compacted remote with a 1% op tail.
@@ -1547,6 +1889,9 @@ def main():
         return
     if "--e2e-multitenant" in sys.argv:
         e2e_multitenant(smoke)
+        return
+    if "--e2e-daemon" in sys.argv:
+        e2e_daemon(smoke)
         return
     N = int(os.environ.get("BENCH_OPS", 50_000 if smoke else 1_000_000))
     R = int(os.environ.get("BENCH_REPLICAS", 500 if smoke else 10_000))

@@ -69,7 +69,7 @@ def main():
     ap.add_argument("--runs", type=int, default=0,
                     help="host runs per config (default BENCH_HOST_RUNS)")
     ap.add_argument("--config", type=int, default=0,
-                    help="re-pin one config (1-5) only")
+                    help="re-pin one config (1-6) only")
     ap.add_argument("--force", action="store_true",
                     help="write pins even past the spread gate (warns)")
     args = ap.parse_args()
@@ -81,7 +81,7 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
 
-    from bench import PINNED_PATH
+    from bench import PINNED_PATH, e2e_daemon_host
     from benchmarks.suite import (
         bench_gcounter, bench_lwwmap, bench_orset, bench_pncounter,
         bench_streaming,
@@ -97,6 +97,12 @@ def main():
         5: lambda: bench_streaming(200_000, 100_000, 1024, ops_per_file=48,
                                    n_host_files=300, iters=0,
                                    host_only=True),
+        # the daemon family (ISSUE 12): sequential solo compacts over
+        # the default --e2e-daemon fleet head shape — the denominator
+        # the daemon's aggregate ops/s is ratioed against, so the
+        # `trend --fail-on-regression` ratchet covers daemon
+        # throughput/freshness from day one
+        6: lambda: e2e_daemon_host(),
     }
 
     try:

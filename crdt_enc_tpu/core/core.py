@@ -922,11 +922,23 @@ class Core:
         own-tail storage probe on the first write of each incarnation
         (a crash between ``store_ops`` and the local-meta update leaves
         an op file the durable cursor does not know about — only
-        storage can reveal it).  When behind, the remote is re-read
-        (own op tail, or the snapshot a peer compacted it into); a
-        remote that STILL does not show the recorded history refuses
-        the write loudly (:class:`StaleWriterError`) rather than
-        corrupting every replica quietly."""
+        storage can reveal it).  The probe has a peer-GC blind spot
+        (simulator-discovered under the daemon vocabulary:
+        ``tests/data/sim/dot_reuse_gc_orphan.json``): a peer's
+        compaction may fold the orphan op file into a snapshot and GC
+        it before this incarnation's first write, destroying the tail
+        evidence — the covering snapshot is then the only carrier of
+        the spent dots.  So when the tail probe of a replica WITH prior
+        history comes up empty, the snapshot listing is checked too:
+        any unread snapshot forces a full re-read before the write, and
+        a listing where EVERY snapshot this replica merged vanished
+        with no unread replacement (a peer GC whose covering snapshot
+        is not yet visible) refuses the write loudly.  When behind,
+        the remote is re-read (own op
+        tail, or the snapshot a peer compacted it into); a remote that
+        STILL does not show the recorded history refuses the write
+        loudly (:class:`StaleWriterError`) rather than corrupting every
+        replica quietly."""
         actor = self.actor_id
         assert self._local_meta is not None
         behind = (
@@ -939,6 +951,56 @@ class Core:
                 tail = await self.storage.stat_ops(
                     [(actor, self._data.next_op_versions.get(actor) + 1)]
                 )
+                if not tail and self._local_meta.last_op_version > 0:
+                    # peer-GC blind spot (docstring): only replicas that
+                    # have EVER written can have a crash orphan, so the
+                    # extra listing is skipped for fresh joiners.  Op
+                    # files only vanish when a covering snapshot became
+                    # durable first (write-new-then-delete-old), so a
+                    # replica with durable history facing an empty op
+                    # tail must see EITHER only snapshots it already
+                    # merged (in sync) or an unread one (re-read first);
+                    # a view where known snapshots vanished — or where
+                    # nothing is visible at all — is inconsistent, and
+                    # writing into it could re-mint dots a peer already
+                    # folded.  (Assumes removes never become visible
+                    # before the snapshot that justified them — the GC
+                    # ordering the whole sync model rests on.)
+                    names = set(await self.storage.list_state_names())
+                    unread = names - self._data.read_states
+                    if unread:
+                        tail = True  # re-read the covering snapshots
+                    elif self._data.read_states and not (
+                        self._data.read_states & names
+                    ):
+                        # EVERY snapshot this replica merged vanished
+                        # and nothing unread replaced it: the covering
+                        # snapshot of that GC is not visible yet.  (A
+                        # ghost name from a stale checkpoint next to a
+                        # listed snapshot we also read is benign — the
+                        # current listing's snapshots collectively
+                        # carry all GC coverage once fully read.)
+                        raise StaleWriterError(
+                            "snapshots this replica merged were "
+                            "garbage-collected but no replacement is "
+                            "visible; writing now could reuse dots the "
+                            "collecting peer's snapshot already folded"
+                        )
+                    elif not names and not await self.storage.stat_ops(
+                        [(actor, 1)]
+                    ):
+                        # zero snapshots anywhere AND the own op log is
+                        # gone below the cursor too: the history went
+                        # SOMEWHERE (a not-yet-visible snapshot) — an
+                        # intact own log (the never-compacted remote)
+                        # passes this probe and writes normally
+                        raise StaleWriterError(
+                            "own durable op history vanished with no "
+                            "covering snapshot visible; writing now "
+                            "could reuse dots it carried"
+                        )
+            except StaleWriterError:
+                raise
             except Exception:
                 # a safety guard must not fail OPEN permanently: the
                 # recorded-cursor check above still fails closed, and

@@ -53,9 +53,13 @@ def device_summaries(paths: list[str]) -> list[dict]:
         records = sink.read_records(path)
         sink.check_schema(records, source=path)
         rep = ts = None
+        counters: dict = {}
+        gauges: dict = {}
         for rec in records:
             if isinstance(rec.get("replication"), dict):
                 rep, ts = rec["replication"], rec.get("ts")
+                counters = rec.get("counters") or {}
+                gauges = rec.get("gauges") or {}
         if rep is None:
             raise FleetInputError(
                 f"{path}: no record carries a replication status — the "
@@ -63,7 +67,14 @@ def device_summaries(paths: list[str]) -> list[dict]:
                 "schema >= 2, CRDT_REPL_SAMPLE unset or 1) to join a "
                 "fleet report"
             )
-        out.append({"path": path, "ts": ts, "replication": rep})
+        out.append({
+            "path": path, "ts": ts, "replication": rep,
+            # the same record's registry snapshot, for the quarantine
+            # column: ingest_quarantined (damaged synced files, cursor
+            # held) and daemon_quarantined (tenants the fleet daemon
+            # has parked, serve/daemon.py)
+            "counters": counters, "gauges": gauges,
+        })
     return out
 
 
@@ -117,6 +128,16 @@ def fleet_report(summaries: list[dict]) -> dict:
                 "backlog_files": rep["backlog"]["files"],
                 "backlog_bytes": rep["backlog"]["bytes"],
                 "watermark_lag": rep["divergence"]["watermark_lag"],
+                # quarantine column: damaged synced files this device
+                # skipped with the cursor held (ingest_quarantined),
+                # plus tenants its fleet daemon currently parks
+                # (daemon_quarantined gauge, serve/daemon.py)
+                "quarantined_files": int(
+                    (s.get("counters") or {}).get("ingest_quarantined", 0)
+                ),
+                "daemon_quarantined": int(
+                    (s.get("gauges") or {}).get("daemon_quarantined", 0)
+                ),
                 # freshness-SLO verdict at the device's last sample:
                 # watermark lag within the active target (obs.slo)
                 "slo_ok": rep["divergence"]["watermark_lag"]
@@ -185,10 +206,14 @@ def format_fleet(report: dict) -> str:
             f"{s['devices_ok']} ok, {s['devices_burning']} burning"
         )
         for d in r["devices"]:
+            quar = d.get("quarantined_files", 0)
+            dq = d.get("daemon_quarantined", 0)
+            quar_s = f"quar={quar}" + (f"+{dq}t" if dq else "")
             lines.append(
                 f"  device {d['actor']}  lag={d['lag']}  "
                 f"backlog_files={d['backlog_files']}  "
                 f"backlog_bytes={d['backlog_bytes']}  "
+                f"{quar_s}  "
                 f"slo={'ok' if d['slo_ok'] else 'BURN'}"
             )
     return "\n".join(lines)

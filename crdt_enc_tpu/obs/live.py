@@ -146,6 +146,9 @@ class LiveTelemetryServer:
         self._devices: dict[tuple[str, str], dict] = {}
         # source name -> last cycle summary (FoldService)
         self._cycles: dict[str, dict] = {}
+        # the owning FleetDaemon's control-plane health (serve/daemon.py):
+        # uptime, cycles, backoff/quarantine counts, drain state
+        self._daemon: dict = {}
 
     # ---------------------------------------------------------- lifecycle
     @property
@@ -200,6 +203,14 @@ class LiveTelemetryServer:
         with self._lock:
             self._cycles[source] = dict(summary)
 
+    def publish_daemon(self, info: dict) -> None:
+        """Store the fleet daemon's control-plane health (the dict
+        :meth:`crdt_enc_tpu.serve.daemon.FleetDaemon.health` builds:
+        uptime, cycles, backoff/quarantine counts, degraded flag, drain
+        state).  Last write wins — one daemon owns a server."""
+        with self._lock:
+            self._daemon = dict(info)
+
     # ---------------------------------------------------------- read side
     def health(self) -> dict:
         """The ``/healthz`` payload: schema-stamped like a sink record,
@@ -207,6 +218,7 @@ class LiveTelemetryServer:
         with self._lock:
             devices = {k: dict(v) for k, v in self._devices.items()}
             cycles = {k: dict(v) for k, v in self._cycles.items()}
+            daemon = dict(self._daemon)
         remotes: dict[str, dict] = {}
         for (remote_id, actor), entry in sorted(devices.items()):
             remotes.setdefault(remote_id, {"devices": {}})[
@@ -218,6 +230,9 @@ class LiveTelemetryServer:
             "ts": round(time.time(), 3),
             "remotes": remotes,
             "cycles": cycles,
+            # empty until a FleetDaemon publishes — the key is always
+            # present so scrapers can probe daemon liveness uniformly
+            "daemon": daemon,
         }
 
 
@@ -310,3 +325,11 @@ def publish_cycle(source: str, summary: dict) -> None:
     srv = default_server()
     if srv is not None:
         srv.publish_cycle(source, summary)
+
+
+def publish_daemon(info: dict) -> None:
+    """Feed the fleet daemon's control-plane health to the default
+    server (the no-server case is one global check, as for publish)."""
+    srv = default_server()
+    if srv is not None:
+        srv.publish_daemon(info)

@@ -6,17 +6,25 @@ device dispatches (docs/multitenant.md).
 * :mod:`.bucketing` — pure ragged-shape planner (quantized size
   classes, spill rules; bounded ``jax_compiles`` across tenant mixes).
 * :mod:`.warm` — tenant-keyed LRU of fold planes under a byte budget.
+* :mod:`.daemon` — :class:`FleetDaemon`: the always-on control plane
+  (staleness scheduling, backoff/quarantine, admission, drain) over a
+  service; ``python -m crdt_enc_tpu.tools.daemon`` runs it.
 """
 
 from .bucketing import Bucket, TenantShape, plan_buckets
+from .daemon import AdmissionError, DaemonConfig, FleetDaemon, TenantEntry
 from .service import FoldService, ServeConfig, TenantResult
 from .warm import PlaneWarmTier, WarmEntry
 
 __all__ = [
+    "AdmissionError",
     "Bucket",
+    "DaemonConfig",
+    "FleetDaemon",
     "FoldService",
     "PlaneWarmTier",
     "ServeConfig",
+    "TenantEntry",
     "TenantResult",
     "TenantShape",
     "WarmEntry",

@@ -239,22 +239,59 @@ class FoldService:
         # last cycle's summary (tenant paths, wall, SLO burn) — what
         # /healthz shows and the cycle sink record carries
         self.last_cycle_summary: dict | None = None
+        # lifecycle guards: a second close() is a logged no-op, a cycle
+        # on a closed service (or overlapping a running one) is a loud
+        # error — never a hang or an interleaved fold
+        self._closed = False
+        self._cycle_running = False
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     def close(self) -> None:
         """Graceful shutdown of service-owned resources (the live
-        telemetry listener; tenants stay open — they are the caller's)."""
+        telemetry listener; tenants stay open — they are the caller's).
+        Idempotent: a second close is a logged no-op, never a hang."""
+        if self._closed:
+            logger.warning("FoldService.close(): already closed (no-op)")
+            return
+        self._closed = True
         if self.live is not None:
             self.live.stop()
 
     # ------------------------------------------------------------- cycle
-    async def run_cycle(self) -> list[TenantResult]:
+    async def run_cycle(self, tenants=None) -> list[TenantResult]:
         """One service cycle: ingest → decode → bucketed mega-folds →
-        per-tenant seal.  Returns one :class:`TenantResult` per tenant
-        (index-aligned with ``self.tenants``).  Tenant failures are
+        per-tenant seal.  ``tenants`` overrides the fleet for THIS cycle
+        (the daemon's staleness scheduler compacts subsets); default is
+        ``self.tenants``.  Returns one :class:`TenantResult` per tenant
+        (index-aligned with the cycled list).  Tenant failures are
         isolated: an erroring tenant reports ``path="error"`` and the
-        rest of the fleet still compacts."""
+        rest of the fleet still compacts.
+
+        NOT reentrant: the fold phase assumes exclusive ownership of the
+        cycle's tenants, so an overlapping ``run_cycle`` (or one on a
+        closed service) raises ``RuntimeError`` immediately instead of
+        silently interleaving two fleets' folds."""
+        if self._closed:
+            raise RuntimeError("FoldService is closed; run_cycle refused")
+        if self._cycle_running:
+            raise RuntimeError(
+                "FoldService.run_cycle is not reentrant: a cycle is "
+                "already in flight on this service"
+            )
+        self._cycle_running = True
+        try:
+            return await self._run_cycle(
+                self.tenants if tenants is None else list(tenants)
+            )
+        finally:
+            self._cycle_running = False
+
+    async def _run_cycle(self, tenants) -> list[TenantResult]:
         t0 = time.perf_counter()
-        works = [_TenantWork(i, core) for i, core in enumerate(self.tenants)]
+        works = [_TenantWork(i, core) for i, core in enumerate(tenants)]
         with trace.span("serve.cycle"):
             await self._ingest_all(works)
             await self._decrypt_all(works)
@@ -267,10 +304,10 @@ class FoldService:
         trace.add("serve_cycles", 1)
         trace.add("serve_tenants", len(works))
         results = [w.result for w in works]
-        await self._publish_cycle(results, time.perf_counter() - t0)
+        await self._publish_cycle(tenants, results, time.perf_counter() - t0)
         return results
 
-    async def _publish_cycle(self, results, wall_s: float) -> None:
+    async def _publish_cycle(self, tenants, results, wall_s: float) -> None:
         """Post-cycle telemetry: the cycle summary (tenant paths, wall,
         per-tenant seal-latency SLO burn) goes to the live /healthz
         endpoint and — when a sink is configured — into one
@@ -307,7 +344,7 @@ class FoldService:
                 # would stamp stale watermark data with a current ts,
                 # hiding exactly the wedged-replica staleness /healthz
                 # exists to expose
-                for core, r in zip(self.tenants, results):
+                for core, r in zip(tenants, results):
                     status = getattr(core, "last_replication_status", None)
                     if r.sealed and status is not None:
                         target.publish_health(status)

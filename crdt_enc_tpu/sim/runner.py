@@ -107,6 +107,7 @@ class SimResult:
     fault_stats: Counter = field(default_factory=Counter)
     transient_missing_key: int = 0
     service_cycles: int = 0
+    daemon_cycles: int = 0
     quarantined: int = 0
     fingerprint: str = ""
 
@@ -139,8 +140,14 @@ class SimRunner:
         ]
         self.transient_missing_key = 0
         self.service_cycles = 0
+        self.daemon_cycles = 0
         self.checks_run = 0
         self._remote = None  # memory backend's shared MemoryRemote
+        # persistent FleetDaemon for the daemon/ddrain vocabulary: one
+        # control-plane instance lives ACROSS steps (that is the point —
+        # its backoff/quarantine state meets the same hostile history
+        # the replicas do); created lazily at the first daemon step
+        self._daemon = None
 
     # ----------------------------------------------------------- plumbing
     def _inner_storage(self, idx: int):
@@ -271,6 +278,7 @@ class SimRunner:
             trace.add("sim_violations", 1)
         result.transient_missing_key = self.transient_missing_key
         result.service_cycles = self.service_cycles
+        result.daemon_cycles = self.daemon_cycles
         result.checks_run = self.checks_run
         result.quarantined = (
             int(trace.snapshot()["counters"].get("ingest_quarantined", 0)) - q0
@@ -330,6 +338,10 @@ class SimRunner:
                     f"dgc{step_idx}"
                 ).remove_deltas([(target.actor_id, 1 << 62)])
             return None
+        if kind == "daemon":
+            return await self._daemon_step(step_idx)
+        if kind == "ddrain":
+            return await self._daemon_drain(step_idx)
         if kind == "reopen":
             if rep.core is None:
                 try:
@@ -439,6 +451,93 @@ class SimRunner:
                     f"tenant r{t.idx}: {res.error}",
                     step_idx,
                 )
+        return None
+
+    # ------------------------------------------------------------ daemon
+    def _daemon_transient(self, err: str) -> bool:
+        return any(
+            t in err
+            for t in ("MissingKeyError", "StaleWriterError",
+                      "IngestDecryptError")
+        )
+
+    async def _daemon_step(self, step_idx: int) -> Violation | None:
+        """One supervised FleetDaemon cycle over the alive fleet: the
+        always-on control plane (serve/daemon.py) inside the hostile
+        history.  The daemon instance persists across steps; its tenant
+        set is synced to replica liveness before the cycle (crashed
+        replicas are discarded, reopened ones re-admitted), and its
+        per-tenant error reprs follow the same discipline as the
+        ``service`` step: crash kills the replica, the documented
+        transient classes are counted, anything else is a violation."""
+        from ..serve import DaemonConfig, FleetDaemon, ServeConfig
+
+        if self._daemon is None:
+            self._daemon = FleetDaemon(
+                config=DaemonConfig(
+                    max_idle_cycles=1,
+                    backoff_base=1.0, backoff_cap=4.0,
+                    quarantine_after=3, quarantine_probe_every=2,
+                    breaker_after=4, breaker_probe_every=2,
+                    serve=ServeConfig(seal_empty=True),
+                ),
+                seed=self.schedule.seed,
+            )
+        daemon = self._daemon
+        await self._daemon_sync(daemon)
+        report = await daemon.run_cycle()
+        self.daemon_cycles += 1
+        for tid, res in report["results"].items():
+            err = res.get("error")
+            if not err:
+                continue
+            rep = self.replicas[int(tid[1:])]
+            if "SimCrash" in err:
+                rep.core = None
+                await daemon.discard(tid)
+            elif self._daemon_transient(err):
+                self.transient_missing_key += 1
+            else:
+                return Violation(
+                    "daemon_error", f"tenant {tid}: {err}", step_idx
+                )
+        return None
+
+    async def _daemon_sync(self, daemon) -> None:
+        """Sync the daemon's tenant set to replica liveness: crashed
+        replicas are discarded (their core handles are dead
+        incarnations the crash model says are gone), reopened ones
+        re-admitted.  Runs before every cycle AND before a drain — a
+        drain must never checkpoint a dead incarnation's handle."""
+        for rep in self.replicas:
+            tid = f"r{rep.idx}"
+            entry = daemon.entry(tid)
+            if rep.core is None:
+                if entry is not None:
+                    await daemon.discard(tid)
+            elif entry is None:
+                await daemon.admit(rep.core, tid=tid)
+            elif entry.core is not rep.core:
+                await daemon.discard(tid)
+                await daemon.admit(rep.core, tid=tid)
+
+    async def _daemon_drain(self, step_idx: int) -> Violation | None:
+        """Graceful drain: checkpoint every tenant, stop the instance.
+        The next ``daemon`` step starts fresh — reopening the fleet's
+        control plane through the checkpoints just sealed."""
+        if self._daemon is None:
+            return None
+        daemon, self._daemon = self._daemon, None
+        await self._daemon_sync(daemon)
+        errors = await daemon.drain()
+        for tid, err in errors.items():
+            if "SimCrash" in err:
+                # the checkpoint write crashed the replica's process
+                self.replicas[int(tid[1:])].core = None
+            else:
+                # a failed drain checkpoint is survivable by design —
+                # the next open falls back cold — never a violation
+                self.transient_missing_key += 1
         return None
 
     # -------------------------------------------------------- quiescence

@@ -33,12 +33,23 @@ Step kinds (``Step.kind``):
 ``dgc``     GC-mid-chain: replica ``arg``'s whole delta log is removed
             out from under every consumer (the hostile move that
             forces the fallback-to-snapshot path)
+``daemon``  one supervised :class:`~crdt_enc_tpu.serve.FleetDaemon`
+            cycle over a PERSISTENT daemon instance whose tenants are
+            the currently-alive replicas (admitted/evicted to match
+            liveness before the cycle runs) — staleness scheduling,
+            backoff and quarantine all face the same hostile storage
+``ddrain``  graceful daemon drain (checkpoints every tenant, stops the
+            instance); the next ``daemon`` step starts a fresh daemon
+            that reopens through the checkpoints
 ========== ==================================================================
 
 ``Schedule.deltas`` turns delta-state replication on for every
 replica's ``OpenOptions``; it defaults OFF so pre-delta fixtures
 replay bit-for-bit, and the generator only emits the ``d*`` step
 kinds (and only perturbs its RNG stream) when it is on.
+``Schedule.daemon`` does the same for the ``daemon``/``ddrain``
+vocabulary (ISSUE 12): default OFF, so every pre-daemon fixture and
+seed replays untouched.
 """
 
 from __future__ import annotations
@@ -65,6 +76,8 @@ STEP_KINDS = (
     "dseal",
     "dread",
     "dgc",
+    "daemon",
+    "ddrain",
 )
 
 
@@ -94,6 +107,7 @@ class Schedule:
     members: int = 12
     backend: str = "memory"  # "memory" (deterministic) | "fs"
     deltas: bool = False  # delta-state replication on every replica
+    daemon: bool = False  # daemon/ddrain vocabulary (FleetDaemon runs)
     note: str = ""
 
     def to_obj(self) -> dict:
@@ -104,6 +118,7 @@ class Schedule:
             "members": self.members,
             "backend": self.backend,
             "deltas": self.deltas,
+            "daemon": self.daemon,
             "faults": self.faults.to_obj(),
             "steps": [s.to_obj() for s in self.steps],
             "note": self.note,
@@ -125,6 +140,7 @@ class Schedule:
             members=int(obj.get("members", 12)),
             backend=backend,
             deltas=bool(obj.get("deltas", False)),
+            daemon=bool(obj.get("daemon", False)),
             note=str(obj.get("note", "")),
         )
         bad = [
@@ -146,6 +162,7 @@ class Schedule:
             members=self.members,
             backend=self.backend,
             deltas=self.deltas,
+            daemon=self.daemon,
             note=self.note,
         )
 
@@ -183,6 +200,15 @@ _DELTA_WEIGHTS = [
     ("dgc", 0.02),
 ]
 
+# daemon vocabulary (ISSUE 12): a steady trickle of supervised control-
+# plane cycles plus the occasional graceful drain (the next daemon step
+# restarts through checkpoints).  Appended only when the daemon flag is
+# on — same RNG-stream preservation rule as the delta vocabulary.
+_DAEMON_WEIGHTS = [
+    ("daemon", 0.06),
+    ("ddrain", 0.01),
+]
+
 
 def generate(
     seed: int,
@@ -193,13 +219,18 @@ def generate(
     members: int = 12,
     backend: str = "memory",
     deltas: bool = False,
+    daemon: bool = False,
 ) -> Schedule:
     """One deterministic schedule from a seed.  Every replica both
     writes and syncs; dead replicas receive only ``reopen`` steps; the
     final step list always ends in enough reopens that the quiescence
     phase starts with a full fleet."""
     rng = random.Random(f"crdt-sim-{seed}")
-    table = _WEIGHTS + (_DELTA_WEIGHTS if deltas else [])
+    table = (
+        _WEIGHTS
+        + (_DELTA_WEIGHTS if deltas else [])
+        + (_DAEMON_WEIGHTS if daemon else [])
+    )
     kinds = [k for k, _ in table]
     weights = [w for _, w in table]
     dead: set[int] = set()
@@ -217,6 +248,11 @@ def generate(
         if kind == "quiesce":
             steps.append(Step("quiesce"))
             dead.clear()  # quiescence reopens every dead replica
+            continue
+        if kind in ("daemon", "ddrain"):
+            # global control-plane steps: the replica field is unused
+            # (the daemon's tenants are whatever is alive at execution)
+            steps.append(Step(kind))
             continue
         if kind == "reopen":
             r = rng.choice(sorted(dead))
@@ -251,4 +287,5 @@ def generate(
         members=members,
         backend=backend,
         deltas=deltas,
+        daemon=daemon,
     )

@@ -1,7 +1,8 @@
 """Adversarial convergence simulator CLI (docs/simulation.md).
 
     python -m crdt_enc_tpu.tools.sim run --seed 42 --replicas 8 \
-        --steps 500 --faults all [--backend memory|fs] [--shrink OUT.json]
+        --steps 500 --faults all [--backend memory|fs] [--deltas] \
+        [--daemon] [--shrink OUT.json]
     python -m crdt_enc_tpu.tools.sim explore --seeds 0:20 --replicas 4 \
         --steps 120 --faults all
     python -m crdt_enc_tpu.tools.sim replay tests/data/sim [FILE.json ...]
@@ -68,6 +69,7 @@ def _report(tag: str, schedule, result) -> None:
         f"{tag}: seed={schedule.seed} replicas={schedule.n_replicas} "
         f"steps={result.steps_run} checks={result.checks_run} "
         f"service_cycles={result.service_cycles} "
+        f"daemon_cycles={result.daemon_cycles} "
         f"quarantined={result.quarantined} faults[{stats}]"
     )
     if result.violation is not None:
@@ -82,6 +84,7 @@ def _cmd_run(args) -> int:
     schedule = generate(
         args.seed, args.replicas, args.steps, faults,
         members=args.members, backend=args.backend, deltas=args.deltas,
+        daemon=args.daemon,
     )
     result = _execute(schedule)
     _report("run", schedule, result)
@@ -116,6 +119,7 @@ def _cmd_explore(args) -> int:
         schedule = generate(
             seed, args.replicas, args.steps, faults,
             members=args.members, backend=args.backend, deltas=args.deltas,
+            daemon=args.daemon,
         )
         result = _execute(schedule)
         _report(f"seed {seed}", schedule, result)
@@ -196,6 +200,10 @@ def main(argv=None) -> int:
                        help="enable delta-state replication on every "
                        "replica + the dseal/dread/dgc step vocabulary "
                        "(docs/delta.md)")
+        p.add_argument("--daemon", action="store_true",
+                       help="enable the daemon/ddrain step vocabulary: "
+                       "a persistent FleetDaemon cycles inside the "
+                       "schedule (docs/multitenant.md)")
 
     p_run = sub.add_parser("run", help="one seeded schedule + checks")
     p_run.add_argument("--seed", type=int, default=0)

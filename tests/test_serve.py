@@ -665,6 +665,90 @@ def test_multitenant_metric_rides_the_trend_gate():
     assert fleet.trend_regressions(t2, 10)
 
 
+# --------------------------------------------------- lifecycle guards
+
+
+def test_close_is_idempotent_and_cycle_after_close_refuses():
+    """Satellite 2 (ISSUE 12): a second ``close()`` is a logged no-op
+    (never a hang), and ``run_cycle`` on a closed service is a loud
+    error instead of silently cycling released resources."""
+
+    async def scenario():
+        core = await Core.open(make_opts(MemoryStorage(MemoryRemote())))
+        service = FoldService([core], live_port=0)
+        port = service.live.port
+        await service.run_cycle()
+        service.close()
+        assert service.closed
+        service.close()  # idempotent — must return, not hang
+        with pytest.raises(RuntimeError, match="closed"):
+            await service.run_cycle()
+        # the live listener really stopped
+        import socket
+
+        with socket.socket() as s:
+            assert s.connect_ex(("127.0.0.1", port)) != 0
+
+    run(scenario())
+
+
+def test_run_cycle_is_not_reentrant():
+    """An overlapping ``run_cycle`` raises immediately: the fold phase
+    assumes exclusive ownership of the cycle's tenants, so interleaving
+    two cycles would interleave two fleets' folds."""
+
+    class StallingStorage(MemoryStorage):
+        def __init__(self, remote, gate):
+            super().__init__(remote)
+            self._gate = gate
+
+        async def list_op_actors(self):
+            await self._gate.wait()
+            return await super().list_op_actors()
+
+    async def scenario():
+        gate = asyncio.Event()
+        gate.set()  # open() samples replication through the listing
+        remote = MemoryRemote()
+        await write_orset(MemoryStorage(remote), 10, b"re")
+        core = await Core.open(make_opts(StallingStorage(remote, gate)))
+        service = FoldService([core])
+        gate.clear()
+        first = asyncio.ensure_future(service.run_cycle())
+        await asyncio.sleep(0)  # first cycle enters its ingest stall
+        with pytest.raises(RuntimeError, match="not reentrant"):
+            await service.run_cycle()
+        gate.set()
+        results = await first
+        assert results[0].error is None
+        # the guard resets: a sequential second cycle is fine
+        (res2,) = await service.run_cycle()
+        assert res2.error is None
+
+    run(scenario())
+
+
+def test_run_cycle_subset_override():
+    """``run_cycle(tenants=...)`` cycles exactly the given subset (the
+    daemon's staleness scheduler) without touching the rest."""
+
+    async def scenario():
+        remotes = [MemoryRemote() for _ in range(3)]
+        for t, r in enumerate(remotes):
+            await write_orset(MemoryStorage(r), 20, b"s%d" % t)
+        served = [
+            await Core.open(make_opts(MemoryStorage(r))) for r in remotes
+        ]
+        service = FoldService(served)
+        results = await service.run_cycle(served[:2])
+        assert len(results) == 2
+        assert all(r.sealed for r in results)
+        # tenant 2 untouched: its remote still has its op backlog
+        assert await served[2].storage.list_op_actors() != []
+
+    run(scenario())
+
+
 # ------------------------------------------------------- fault isolation
 
 

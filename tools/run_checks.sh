@@ -33,6 +33,22 @@ echo "== delta-enabled sim smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
     --seed 0 --replicas 4 --steps 80 --faults all --deltas
 
+echo "== daemon-enabled sim smoke (bounded) =="
+# a persistent FleetDaemon cycles INSIDE the all-fault schedule
+# (daemon/ddrain vocabulary): crash/reopen, torn reads and delayed
+# visibility hit the control plane too, and the five quiescence
+# invariants check it like any replica (docs/multitenant.md)
+JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
+    --seed 0 --replicas 4 --steps 80 --faults all --daemon
+
+echo "== daemon smoke: faulted cycles -> drain -> fsck =="
+# bounded always-on daemon selftest: an in-memory fleet with injected
+# tenant faults runs supervised cycles (errors must isolate into
+# backoff/quarantine while healthy tenants keep sealing), heals,
+# recovers, drains, and every remote must fsck clean + refold solo
+JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.daemon selftest \
+    --tenants 6 --cycles 6 --faulty 2
+
 echo "== delta-vs-snapshot differential gate =="
 # chained delta consumers must be byte-identical to full-snapshot
 # consumers across adapters (incl. the composed resettable counter)
