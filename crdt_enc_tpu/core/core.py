@@ -635,11 +635,33 @@ class Core:
     def _pack_checkpoint_state(self):
         """(fmt, obj) for the current state: the packed-columnar ORSet
         encoding when it applies losslessly, else the adapter's generic
-        object form (identical to the compacted-snapshot payload)."""
+        object form (identical to the compacted-snapshot payload).
+
+        A fresh streaming fold stashes its surviving rows on the state
+        (``_ckpt_rows``, mut-epoch-guarded — ops/columnar.py
+        ``_orset_fresh_fold_native``); when the state provably has not
+        mutated since, the checkpoint packs straight from those rows —
+        the zero-copy decode→planes tail, no dict walk (the solo twin
+        of the fold service's planes-packed ``_packed`` path)."""
         state = self._data.state
         if type(state) is ORSet:
-            from ..ops.columnar import orset_pack_checkpoint
+            from ..ops.columnar import (
+                orset_pack_checkpoint, orset_pack_checkpoint_rows,
+            )
 
+            stash = getattr(state, "_ckpt_rows", None)
+            if stash is not None:
+                # consume the stash either way: a stale one (mutated
+                # since the fold) is dead weight, and a used one has
+                # served its purpose — without this the row arrays and
+                # both vocab object lists stay pinned to the state for
+                # its whole lifetime
+                state._ckpt_rows = None
+                if stash[0] == getattr(state, "_mut", None):
+                    return (
+                        CHECKPOINT_FMT_ORSET,
+                        orset_pack_checkpoint_rows(*stash[1]),
+                    )
             obj = orset_pack_checkpoint(state)
             if obj is not None:
                 return CHECKPOINT_FMT_ORSET, obj

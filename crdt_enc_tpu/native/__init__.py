@@ -105,6 +105,7 @@ def load_state() -> ctypes.PyDLL:
 
 def _bind_state(lib) -> None:
     i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
     lib.orset_fresh_fold.argtypes = [
         ctypes.POINTER(ctypes.c_int8), i32p, i32p, i32p, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_int64, i32p,
@@ -112,6 +113,20 @@ def _bind_state(lib) -> None:
         ctypes.py_object, ctypes.py_object,
     ]
     lib.orset_fresh_fold.restype = ctypes.c_int
+    # split fresh fold: rows handle out (counts[2] is the capacity
+    # channel for the later take), then a sized copy-out + free
+    lib.orset_fold_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_int8), i32p, i32p, i32p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, i32p, i64p,
+    ]
+    lib.orset_fold_rows.restype = ctypes.c_void_p
+    lib.orset_fold_rows_take.argtypes = [
+        ctypes.c_void_p, i32p, i32p, i64p, ctypes.c_int64,
+        i32p, i32p, i64p, ctypes.c_int64,
+    ]
+    lib.orset_fold_rows_take.restype = ctypes.c_int
+    lib.orset_fold_rows_drop.argtypes = [ctypes.c_void_p]
+    lib.orset_fold_rows_drop.restype = None
     lib.dense_clock_dict.argtypes = [i32p, ctypes.c_int64, ctypes.py_object]
     lib.dense_clock_dict.restype = ctypes.py_object
     lib.grouped_rows_dicts.argtypes = [

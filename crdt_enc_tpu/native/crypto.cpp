@@ -235,22 +235,112 @@ void chacha20_xor_lanes16(const uint8_t key[32], uint32_t counter,
   }
 }
 
+// 4 independent keystream blocks in 128-bit vectors — the guaranteed
+// SIMD baseline (SSE2 on any x86-64, NEON q-registers on aarch64): one
+// xmm/q register per ChaCha word.  This is the widest shape that never
+// needs an ISA the build target might lack, so it is the runtime
+// dispatcher's floor before the scalar tail.
+constexpr int LANES4 = 4;
+typedef uint32_t v4u __attribute__((vector_size(4 * LANES4)));
+
+static inline v4u rotlv4(v4u x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+
+void chacha20_xor_lanes4(const uint8_t key[32], uint32_t counter,
+                         const uint8_t nonce[12], const uint8_t* in,
+                         uint8_t* out) {
+  uint32_t init[16];
+  for (int i = 0; i < 4; i++) init[i] = SIGMA[i];
+  for (int i = 0; i < 8; i++) init[4 + i] = load32_le(key + 4 * i);
+  init[12] = counter;
+  for (int i = 0; i < 3; i++) init[13 + i] = load32_le(nonce + 4 * i);
+
+  v4u x[16];
+  for (int i = 0; i < 16; i++)
+    for (int j = 0; j < LANES4; j++) x[i][j] = init[i];
+  for (int j = 0; j < LANES4; j++) x[12][j] = counter + (uint32_t)j;
+
+#define QRV4(a, b, c, d)                                     \
+  x[a] += x[b]; x[d] ^= x[a]; x[d] = rotlv4(x[d], 16);       \
+  x[c] += x[d]; x[b] ^= x[c]; x[b] = rotlv4(x[b], 12);       \
+  x[a] += x[b]; x[d] ^= x[a]; x[d] = rotlv4(x[d], 8);        \
+  x[c] += x[d]; x[b] ^= x[c]; x[b] = rotlv4(x[b], 7);
+
+  for (int r = 0; r < 10; r++) {
+    QRV4(0, 4, 8, 12)
+    QRV4(1, 5, 9, 13)
+    QRV4(2, 6, 10, 14)
+    QRV4(3, 7, 11, 15)
+    QRV4(0, 5, 10, 15)
+    QRV4(1, 6, 11, 12)
+    QRV4(2, 7, 8, 13)
+    QRV4(3, 4, 9, 14)
+  }
+#undef QRV4
+
+  for (int j = 0; j < LANES4; j++) {
+    const uint8_t* src = in + (uint64_t)j * 64;
+    uint8_t* dst = out + (uint64_t)j * 64;
+    for (int i = 0; i < 16; i++) {
+      uint32_t word = x[i][j] + init[i] + (i == 12 ? (uint32_t)j : 0);
+      store32_le(dst + 4 * i, load32_le(src + 4 * i) ^ word);
+    }
+  }
+}
+
+// Runtime SIMD dispatch: the usable lane width is the MIN of what this
+// translation unit was compiled for (wider vector-extension code may
+// contain instructions the build ISA allows) and what the running CPU
+// actually supports — a build/ copied from an AVX-512 box must degrade
+// to the 8/4-lane loops on an AVX2/SSE2 host instead of faulting.  On
+// non-x86 the compile-time width is authoritative (vector extensions
+// lower to the target baseline, NEON on aarch64).
+static int simd_lanes_detect() {
+  int compiled = LANES4;
+#if defined(__AVX512F__) && defined(__AVX512BW__)
+  compiled = LANES16;
+#elif defined(__AVX2__)
+  compiled = LANES;
+#endif
+  int runtime = compiled;
+#if (defined(__x86_64__) || defined(__i386__)) && defined(__GNUC__)
+  __builtin_cpu_init();
+  if (__builtin_cpu_supports("avx512f") && __builtin_cpu_supports("avx512bw"))
+    runtime = LANES16;
+  else if (__builtin_cpu_supports("avx2"))
+    runtime = LANES;
+  else
+    runtime = LANES4;
+#endif
+  return runtime < compiled ? runtime : compiled;
+}
+
+static const int SIMD_LANES = simd_lanes_detect();
+
 void chacha20_xor(const uint8_t key[32], uint32_t counter,
                   const uint8_t nonce[12], const uint8_t* in, uint8_t* out,
                   uint64_t len) {
-  while (len >= 64 * LANES16) {
+  while (SIMD_LANES >= LANES16 && len >= 64 * LANES16) {
     chacha20_xor_lanes16(key, counter, nonce, in, out);
     counter += LANES16;
     in += 64 * LANES16;
     out += 64 * LANES16;
     len -= 64 * LANES16;
   }
-  while (len >= 64 * LANES) {
+  while (SIMD_LANES >= LANES && len >= 64 * LANES) {
     chacha20_xor_lanes(key, counter, nonce, in, out);
     counter += LANES;
     in += 64 * LANES;
     out += 64 * LANES;
     len -= 64 * LANES;
+  }
+  while (len >= 64 * LANES4) {
+    chacha20_xor_lanes4(key, counter, nonce, in, out);
+    counter += LANES4;
+    in += 64 * LANES4;
+    out += 64 * LANES4;
+    len -= 64 * LANES4;
   }
   uint8_t block[64];
   while (len > 0) {
@@ -543,6 +633,41 @@ int xchacha20poly1305_decrypt(const uint8_t* key, const uint8_t* nonce24,
                                   out);
 }
 
+// Defined with the batched engine at the bottom of this file: the
+// shared SIMD decrypt core both the EncBox scatter path and the raw
+// batch surfaces below route through.
+int encbox_decrypt_scatter_mt(const uint8_t* key, const uint8_t* blobs,
+                              const uint64_t* nonce_offs,
+                              const uint64_t* ct_offs,
+                              const uint64_t* ct_lens, uint64_t n,
+                              uint8_t* out, const uint64_t* out_offs,
+                              uint8_t* ok_flags, int n_threads);
+
+namespace {
+
+// Adapt the flat (nonces n*24, cts + offsets[n+1]) batch layout to the
+// batched engine's absolute-address span form (NULL blob base — the
+// same convention encbox_parse_batch_ptrs emits), so the raw batch FFI
+// surface shares the multi-lane ChaCha phases and the batched Poly1305
+// pass with the EncBox path instead of looping the scalar decrypt.
+int batch_via_engine(const uint8_t* key, const uint8_t* nonces,
+                     const uint8_t* cts, const uint64_t* offsets, uint64_t n,
+                     uint8_t* out, const uint64_t* out_offsets,
+                     uint8_t* ok_flags, int n_threads) {
+  if (n == 0) return 0;
+  std::vector<uint64_t> nonce_offs(n), ct_offs(n), ct_lens(n);
+  for (uint64_t i = 0; i < n; i++) {
+    nonce_offs[i] = (uint64_t)(uintptr_t)(nonces + 24 * i);
+    ct_offs[i] = (uint64_t)(uintptr_t)(cts + offsets[i]);
+    ct_lens[i] = offsets[i + 1] - offsets[i];
+  }
+  return encbox_decrypt_scatter_mt(key, nullptr, nonce_offs.data(),
+                                   ct_offs.data(), ct_lens.data(), n, out,
+                                   out_offsets, ok_flags, n_threads);
+}
+
+}  // namespace
+
 // Batch XChaCha decrypt: n blobs, one shared key, per-blob nonce + ct.
 // Inputs are flattened: nonces (n*24), cts concatenated with offsets[n+1].
 // Outputs into `out` at out_offsets[i] = offsets[i] - 16*i shape (each pt is
@@ -552,16 +677,8 @@ int xchacha20poly1305_decrypt_batch(const uint8_t* key, const uint8_t* nonces,
                                     const uint64_t* offsets, uint64_t n,
                                     uint8_t* out, const uint64_t* out_offsets,
                                     uint8_t* ok_flags) {
-  int failures = 0;
-  for (uint64_t i = 0; i < n; i++) {
-    const uint8_t* ct = cts + offsets[i];
-    uint64_t ct_len = offsets[i + 1] - offsets[i];
-    int rc = xchacha20poly1305_decrypt(key, nonces + 24 * i, nullptr, 0, ct,
-                                       ct_len, out + out_offsets[i]);
-    ok_flags[i] = rc == 0 ? 1 : 0;
-    if (rc != 0) failures++;
-  }
-  return failures;
+  return batch_via_engine(key, nonces, cts, offsets, n, out, out_offsets,
+                          ok_flags, 1);
 }
 
 // Threaded batch decrypt: blobs are independent (per-blob nonce, disjoint
@@ -574,35 +691,14 @@ int xchacha20poly1305_decrypt_batch_mt(const uint8_t* key,
                                        uint8_t* out,
                                        const uint64_t* out_offsets,
                                        uint8_t* ok_flags, int n_threads) {
-  if (n_threads <= 1 || n < 2)
-    return xchacha20poly1305_decrypt_batch(key, nonces, cts, offsets, n, out,
-                                           out_offsets, ok_flags);
-  if ((uint64_t)n_threads > n) n_threads = (int)n;
-  std::vector<std::thread> workers;
-  std::vector<int> fails((size_t)n_threads, 0);
-  uint64_t stride = (n + n_threads - 1) / n_threads;
-  for (int t = 0; t < n_threads; t++) {
-    uint64_t lo = t * stride;
-    uint64_t hi = lo + stride < n ? lo + stride : n;
-    if (lo >= hi) break;
-    workers.emplace_back([=, &fails]() {
-      int f = 0;
-      for (uint64_t i = lo; i < hi; i++) {
-        const uint8_t* ct = cts + offsets[i];
-        uint64_t ct_len = offsets[i + 1] - offsets[i];
-        int rc = xchacha20poly1305_decrypt(key, nonces + 24 * i, nullptr, 0,
-                                           ct, ct_len, out + out_offsets[i]);
-        ok_flags[i] = rc == 0 ? 1 : 0;
-        if (rc != 0) f++;
-      }
-      fails[t] = f;
-    });
-  }
-  for (auto& w : workers) w.join();
-  int failures = 0;
-  for (int f : fails) failures += f;
-  return failures;
+  return batch_via_engine(key, nonces, cts, offsets, n, out, out_offsets,
+                          ok_flags, n_threads);
 }
+
+// The resolved SIMD lane width (16 = AVX-512, 8 = AVX2, 4 = SSE2/NEON
+// baseline) — exported so tests and diagnostics can see which keystream
+// path this process actually runs.
+int crdt_simd_lanes(void) { return SIMD_LANES; }
 
 }  // extern "C"
 
@@ -718,20 +814,85 @@ int64_t encbox_parse_batch_ptrs(const uint8_t* const* blob_ptrs,
   return total;
 }
 
-// Threaded batch decrypt reading nonce/ct in place via the offsets the
-// parse produced — zero intermediate copies.  Output spans are disjoint
-// (out_offs from an exclusive scan of ct_lens-16).  Returns failure count.
+}  // extern "C" (parse entry points; batched decrypt engine follows)
 
 // ---- batched small-blob decrypt helpers ---------------------------------
 //
 // The streaming workload (config 5) is ~100k tiny files sealed under ONE
 // key: the per-file fixed crypto (HChaCha20 subkey, Poly1305 one-time-key
 // block, 2-4 data blocks) dominates.  All of it is ChaCha rounds on
-// independent states, so 16 files' worth runs per 512-bit vector pass —
-// only the state *init* differs per lane (nonce / subkey / counter), and
-// the QR rounds are elementwise regardless.
+// independent states, so a vector register's worth of files runs per
+// pass — only the state *init* differs per lane (nonce / subkey /
+// counter), and the QR rounds are elementwise regardless.  The lane
+// width follows the runtime dispatch (16 on AVX-512, 8 on AVX2, 4 on
+// the SSE2/NEON baseline — C++ templates outside the C-linkage block);
+// every width is cross-checked against the pure-Python oracle in
+// tests/test_native_crypto.py.
 
-// 16 independent HChaCha20 derivations (shared key, per-lane nonce16).
+namespace {
+
+template <typename V>
+static inline V rotlvN(V x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+
+#define QRN(a, b, c, d)                                      \
+  x[a] += x[b]; x[d] ^= x[a]; x[d] = rotlvN(x[d], 16);       \
+  x[c] += x[d]; x[b] ^= x[c]; x[b] = rotlvN(x[b], 12);       \
+  x[a] += x[b]; x[d] ^= x[a]; x[d] = rotlvN(x[d], 8);        \
+  x[c] += x[d]; x[b] ^= x[c]; x[b] = rotlvN(x[b], 7);
+
+// L independent HChaCha20 derivations (shared key, per-lane nonce16).
+template <typename V, int L>
+static void hchacha20_xN(const uint8_t key[32], const uint8_t* const* nonces,
+                         uint8_t (*subkeys)[32], int count) {
+  uint32_t kw[8];
+  for (int i = 0; i < 8; i++) kw[i] = load32_le(key + 4 * i);
+  V x[16];
+  for (int i = 0; i < 4; i++) x[i] = SIGMA[i] - (V){};
+  for (int i = 0; i < 8; i++) x[4 + i] = kw[i] - (V){};
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < L; j++)
+      x[12 + i][j] = load32_le(nonces[j < count ? j : 0] + 4 * i);
+  for (int r = 0; r < 10; r++) {
+    QRN(0, 4, 8, 12) QRN(1, 5, 9, 13) QRN(2, 6, 10, 14) QRN(3, 7, 11, 15)
+    QRN(0, 5, 10, 15) QRN(1, 6, 11, 12) QRN(2, 7, 8, 13) QRN(3, 4, 9, 14)
+  }
+  for (int j = 0; j < count; j++) {
+    for (int i = 0; i < 4; i++) store32_le(subkeys[j] + 4 * i, x[i][j]);
+    for (int i = 0; i < 4; i++)
+      store32_le(subkeys[j] + 16 + 4 * i, x[12 + i][j]);
+  }
+}
+
+// L independent ChaCha20 blocks, each with its own key/nonce/counter.
+template <typename V, int L>
+static void chacha20_block_xN(const uint8_t* const* keys,
+                              const uint32_t* counters,
+                              const uint8_t* const* nonces12,
+                              uint8_t (*outs)[64], int count) {
+  V x[16], iv[16];
+  for (int i = 0; i < 4; i++) iv[i] = SIGMA[i] - (V){};
+  for (int i = 0; i < 8; i++)
+    for (int j = 0; j < L; j++)
+      iv[4 + i][j] = load32_le(keys[j < count ? j : 0] + 4 * i);
+  for (int j = 0; j < L; j++) iv[12][j] = counters[j < count ? j : 0];
+  for (int i = 0; i < 3; i++)
+    for (int j = 0; j < L; j++)
+      iv[13 + i][j] = load32_le(nonces12[j < count ? j : 0] + 4 * i);
+  for (int i = 0; i < 16; i++) x[i] = iv[i];
+  for (int r = 0; r < 10; r++) {
+    QRN(0, 4, 8, 12) QRN(1, 5, 9, 13) QRN(2, 6, 10, 14) QRN(3, 7, 11, 15)
+    QRN(0, 5, 10, 15) QRN(1, 6, 11, 12) QRN(2, 7, 8, 13) QRN(3, 4, 9, 14)
+  }
+#undef QRN
+  for (int i = 0; i < 16; i++) x[i] += iv[i];
+  for (int j = 0; j < count; j++)
+    for (int i = 0; i < 16; i++) store32_le(outs[j] + 4 * i, x[i][j]);
+}
+
+// 16 independent HChaCha20 derivations (shared key, per-lane nonce16) —
+// the AVX-512 shape with the in-register output transpose.
 static void hchacha20_x16(const uint8_t key[32],
                           const uint8_t* const nonces[16],
                           uint8_t subkeys[][32], int count) {
@@ -786,49 +947,87 @@ static void chacha20_block_x16(const uint8_t* const keys[16],
   for (int j = 0; j < count; j++) memcpy(outs[j], &x[j], 64);
 }
 
+// Per-lane-width kernel selection for the batched engine: 16 lanes use
+// the transpose-optimized AVX-512 shapes above, narrower widths the
+// generic templates (scalar lane extraction — 8/4 lanes have too few
+// words per register for the butterfly transpose to pay).
+template <int L> struct BatchKern;
+template <> struct BatchKern<4> {
+  static void hch(const uint8_t key[32], const uint8_t* const* nonces,
+                  uint8_t (*sk)[32], int c) {
+    hchacha20_xN<v4u, 4>(key, nonces, sk, c);
+  }
+  static void blk(const uint8_t* const* keys, const uint32_t* ctr,
+                  const uint8_t* const* n12, uint8_t (*o)[64], int c) {
+    chacha20_block_xN<v4u, 4>(keys, ctr, n12, o, c);
+  }
+};
+template <> struct BatchKern<8> {
+  static void hch(const uint8_t key[32], const uint8_t* const* nonces,
+                  uint8_t (*sk)[32], int c) {
+    hchacha20_xN<v8u, 8>(key, nonces, sk, c);
+  }
+  static void blk(const uint8_t* const* keys, const uint32_t* ctr,
+                  const uint8_t* const* n12, uint8_t (*o)[64], int c) {
+    chacha20_block_xN<v8u, 8>(keys, ctr, n12, o, c);
+  }
+};
+template <> struct BatchKern<16> {
+  static void hch(const uint8_t key[32], const uint8_t* const* nonces,
+                  uint8_t (*sk)[32], int c) {
+    hchacha20_x16(key, nonces, sk, c);
+  }
+  static void blk(const uint8_t* const* keys, const uint32_t* ctr,
+                  const uint8_t* const* n12, uint8_t (*o)[64], int c) {
+    chacha20_block_x16(keys, ctr, n12, o, c);
+  }
+};
+
 // Batched decrypt of n same-key blobs: three vectorized ChaCha phases
-// (subkeys, one-time poly keys, data keystream jobs) + scalar Poly1305
-// per file.  Writes cleartext only where the tag verifies.
-static int encbox_decrypt_batched(const uint8_t* key, const uint8_t* blobs,
-                                  const uint64_t* nonce_offs,
-                                  const uint64_t* ct_offs,
-                                  const uint64_t* ct_lens, uint64_t n,
-                                  uint8_t* out, const uint64_t* out_offs,
-                                  uint8_t* ok_flags) {
+// (subkeys, one-time poly keys, data keystream jobs) + a batched scalar
+// Poly1305 verification pass.  Writes cleartext only where the tag
+// verifies.  Lane width L follows the runtime dispatch (see the
+// non-template front door below).
+template <int L>
+static int encbox_decrypt_batched_impl(
+    const uint8_t* key, const uint8_t* blobs, const uint64_t* nonce_offs,
+    const uint64_t* ct_offs, const uint64_t* ct_lens, uint64_t n,
+    uint8_t* out, const uint64_t* out_offs, uint8_t* ok_flags) {
   std::vector<std::array<uint8_t, 32>> subkeys(n);
   std::vector<std::array<uint8_t, 12>> n12(n);
   std::vector<std::array<uint8_t, 64>> otk(n);
 
   // phase 1: subkeys (HChaCha20 over nonce24[0:16))
-  for (uint64_t i = 0; i < n; i += 16) {
-    int c = (int)((n - i) < 16 ? (n - i) : 16);
-    const uint8_t* np[16];
+  for (uint64_t i = 0; i < n; i += L) {
+    int c = (int)((n - i) < (uint64_t)L ? (n - i) : (uint64_t)L);
+    const uint8_t* np[L];
     uint8_t(*sk)[32] = (uint8_t(*)[32])subkeys[i].data();
-    for (int j = 0; j < 16; j++)
+    for (int j = 0; j < L; j++)
       np[j] = blob_at(blobs, nonce_offs[i + (j < c ? j : 0)]);
-    hchacha20_x16(key, np, sk, c);
+    BatchKern<L>::hch(key, np, sk, c);
   }
   for (uint64_t i = 0; i < n; i++) {
     memset(n12[i].data(), 0, 4);
     memcpy(n12[i].data() + 4, blob_at(blobs, nonce_offs[i]) + 16, 8);
   }
   // phase 2: Poly1305 one-time keys (block 0 of each file's stream)
-  for (uint64_t i = 0; i < n; i += 16) {
-    int c = (int)((n - i) < 16 ? (n - i) : 16);
-    const uint8_t* kp[16];
-    const uint8_t* np[16];
-    uint32_t ctr[16] = {0};
+  for (uint64_t i = 0; i < n; i += L) {
+    int c = (int)((n - i) < (uint64_t)L ? (n - i) : (uint64_t)L);
+    const uint8_t* kp[L];
+    const uint8_t* np[L];
+    uint32_t ctr[L] = {0};
     uint8_t(*op)[64] = (uint8_t(*)[64])otk[i].data();
-    for (int j = 0; j < 16; j++) {
+    for (int j = 0; j < L; j++) {
       uint64_t ix = i + (j < c ? j : 0);
       kp[j] = subkeys[ix].data();
       np[j] = n12[ix].data();
     }
-    chacha20_block_x16(kp, ctr, np, op, c);
+    BatchKern<L>::blk(kp, ctr, np, op, c);
   }
-  // phase 3: Poly1305 tag check per file (radix-2^44 core) — BEFORE any
-  // keystream XOR, matching the scalar path's verify-then-decrypt order:
-  // a blob whose tag fails must never have plaintext written for it
+  // phase 3: batched Poly1305 pass — every file's tag verified in one
+  // sweep (radix-2^44 core, two-block interleave) BEFORE any keystream
+  // XOR, matching the scalar path's verify-then-decrypt order: a blob
+  // whose tag fails must never have plaintext written for it
   int failures = 0;
   for (uint64_t i = 0; i < n; i++) {
     if (ct_lens[i] < 16) {
@@ -864,19 +1063,19 @@ static int encbox_decrypt_batched(const uint8_t* key, const uint8_t* blobs,
     for (uint64_t b = 0; b * 64 < data_len; b++)
       jobs.push_back({i, (uint32_t)(b + 1)});
   }
-  uint8_t ks[16][64];
-  for (size_t q = 0; q < jobs.size(); q += 16) {
-    int c = (int)((jobs.size() - q) < 16 ? (jobs.size() - q) : 16);
-    const uint8_t* kp[16];
-    const uint8_t* np[16];
-    uint32_t ctr[16];
-    for (int j = 0; j < 16; j++) {
+  uint8_t ks[L][64];
+  for (size_t q = 0; q < jobs.size(); q += L) {
+    int c = (int)((jobs.size() - q) < (size_t)L ? (jobs.size() - q) : (size_t)L);
+    const uint8_t* kp[L];
+    const uint8_t* np[L];
+    uint32_t ctr[L];
+    for (int j = 0; j < L; j++) {
       const Job& jb = jobs[q + (j < c ? j : 0)];
       kp[j] = subkeys[jb.file].data();
       np[j] = n12[jb.file].data();
       ctr[j] = jb.ctr;
     }
-    chacha20_block_x16(kp, ctr, np, ks, c);
+    BatchKern<L>::blk(kp, ctr, np, ks, c);
     for (int j = 0; j < c; j++) {
       const Job& jb = jobs[q + j];
       uint64_t data_len = ct_lens[jb.file] - 16;
@@ -890,6 +1089,34 @@ static int encbox_decrypt_batched(const uint8_t* key, const uint8_t* blobs,
   return failures;
 }
 
+// Runtime-dispatched front door: widest lane shape the build AND the
+// running CPU both support (SIMD_LANES), so one .so degrades gracefully
+// instead of faulting on a narrower host.
+static int encbox_decrypt_batched(const uint8_t* key, const uint8_t* blobs,
+                                  const uint64_t* nonce_offs,
+                                  const uint64_t* ct_offs,
+                                  const uint64_t* ct_lens, uint64_t n,
+                                  uint8_t* out, const uint64_t* out_offs,
+                                  uint8_t* ok_flags) {
+  if (SIMD_LANES >= LANES16)
+    return encbox_decrypt_batched_impl<16>(key, blobs, nonce_offs, ct_offs,
+                                           ct_lens, n, out, out_offs,
+                                           ok_flags);
+  if (SIMD_LANES >= LANES)
+    return encbox_decrypt_batched_impl<8>(key, blobs, nonce_offs, ct_offs,
+                                          ct_lens, n, out, out_offs,
+                                          ok_flags);
+  return encbox_decrypt_batched_impl<4>(key, blobs, nonce_offs, ct_offs,
+                                        ct_lens, n, out, out_offs, ok_flags);
+}
+
+}  // namespace (batched decrypt engine)
+
+extern "C" {
+
+// Threaded batch decrypt reading nonce/ct in place via the offsets the
+// parse produced — zero intermediate copies.  Output spans are disjoint
+// (out_offs from an exclusive scan of ct_lens-16).  Returns failure count.
 int encbox_decrypt_scatter_mt(const uint8_t* key, const uint8_t* blobs,
                               const uint64_t* nonce_offs,
                               const uint64_t* ct_offs,

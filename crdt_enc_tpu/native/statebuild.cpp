@@ -233,6 +233,144 @@ int orset_fresh_fold_impl(const int8_t* kind, const int32_t* member,
     return 0;
 }
 
+// ---- split fold: rows out, dicts assembled separately ---------------------
+//
+// The monolithic orset_fresh_fold above fuses the FOLD (gate + radix
+// sort + dedup + survivor filter — pure C, a few ms) with the STATE
+// WRITEBACK (CPython dict assembly — the dominant cost at 200k rows).
+// The split protocol below returns the surviving rows as plain int
+// arrays FIRST — member-contiguous, actor-ascending: exactly the
+// orset_pack_checkpoint row layout — so the caller can (a) time fold
+// vs writeback honestly (the gap report's fold marginal), (b) hand the
+// SAME rows to grouped_rows_dicts for the dict writeback, and (c) seal
+// the warm-open checkpoint straight from the rows with no dict walk.
+
+namespace {
+
+struct FoldRows {
+    std::vector<int64_t> aseg, aval, rseg, rval;
+    int64_t R;
+};
+
+}  // namespace
+
+// Fold a raw op batch against an empty state: merged clock in place,
+// surviving add/remove rows retained on the returned handle.  Writes
+// {n_adds, n_removes} into counts.  Returns NULL when the shape
+// overflows the packed-key sort or allocation fails (caller falls back
+// to the fused/Python paths; clock may be partially merged — callers
+// pass a scratch copy).
+void* orset_fold_rows(const int8_t* kind, const int32_t* member,
+                      const int32_t* actor, const int32_t* counter,
+                      int64_t n, int64_t E, int64_t R, int32_t* clock,
+                      int64_t* counts) {
+    try {
+        int64_t maxc = 0;
+        for (int64_t i = 0; i < n; ++i) {
+            if (actor[i] >= R) continue;
+            if (counter[i] > maxc) maxc = counter[i];
+        }
+        const uint64_t M = (uint64_t)maxc + 1;
+        const uint64_t segspace = (uint64_t)E * (uint64_t)R;
+        if (segspace != 0 && M > (((uint64_t)1 << 62) / (segspace + 1)))
+            return nullptr;
+        std::vector<int32_t> clock0(clock, clock + (size_t)R);
+        std::vector<uint64_t> adds, rms;
+        adds.reserve((size_t)n);
+        for (int64_t i = 0; i < n; ++i) {
+            const int32_t a = actor[i];
+            if (a < 0 || a >= R) continue;
+            const int64_t c = counter[i];
+            if (c < 0) continue;
+            const uint64_t seg =
+                (uint64_t)member[i] * (uint64_t)R + (uint64_t)a;
+            if (kind[i] == 0) {
+                if (c > clock0[a]) {
+                    adds.push_back(seg * M + (uint64_t)c);
+                    if (c > clock[a]) clock[a] = (int32_t)c;
+                }
+            } else if (kind[i] == 1) {
+                rms.push_back(seg * M + (uint64_t)c);
+            }
+        }
+        const uint64_t maxpacked =
+            segspace == 0 ? 0 : (segspace - 1) * M + maxc;
+        radix_sort_u64(adds, maxpacked);
+        radix_sort_u64(rms, maxpacked);
+
+        FoldRows* out = new FoldRows;
+        out->R = R;
+        dedup(adds, M, out->aseg, out->aval);
+        dedup(rms, M, out->rseg, out->rval);
+        {
+            size_t keep = 0, r = 0;
+            for (size_t i = 0; i < out->aseg.size(); ++i) {
+                while (r < out->rseg.size() && out->rseg[r] < out->aseg[i])
+                    ++r;
+                const int64_t horizon =
+                    (r < out->rseg.size() && out->rseg[r] == out->aseg[i])
+                        ? out->rval[r] : 0;
+                if (out->aval[i] > horizon) {
+                    out->aseg[keep] = out->aseg[i];
+                    out->aval[keep] = out->aval[i];
+                    ++keep;
+                }
+            }
+            out->aseg.resize(keep);
+            out->aval.resize(keep);
+        }
+        {
+            size_t keep = 0;
+            for (size_t i = 0; i < out->rseg.size(); ++i) {
+                if (out->rval[i] > clock[out->rseg[i] % R]) {
+                    out->rseg[keep] = out->rseg[i];
+                    out->rval[keep] = out->rval[i];
+                    ++keep;
+                }
+            }
+            out->rseg.resize(keep);
+            out->rval.resize(keep);
+        }
+        counts[0] = (int64_t)out->aseg.size();
+        counts[1] = (int64_t)out->rseg.size();
+        return out;
+    } catch (const std::bad_alloc&) {
+        return nullptr;
+    }
+}
+
+// Copy the surviving rows out as (member, actor, counter) columns —
+// member-contiguous (sort order), actor ascending within a member, the
+// orset_pack_checkpoint group contract — and free the handle.  The
+// caller sizes the six arrays from the counts orset_fold_rows wrote and
+// passes them back as the write bounds; a mismatch (stale counts, a
+// caller bug) writes NOTHING past either capacity and returns -1.
+int orset_fold_rows_take(void* handle, int32_t* am, int32_t* aa,
+                         int64_t* ac, int64_t a_capacity, int32_t* dm,
+                         int32_t* da, int64_t* dc, int64_t d_capacity) {
+    FoldRows* rows = (FoldRows*)handle;
+    if ((int64_t)rows->aseg.size() != a_capacity ||
+        (int64_t)rows->rseg.size() != d_capacity) {
+        delete rows;
+        return -1;
+    }
+    const int64_t R = rows->R;
+    for (size_t i = 0; i < rows->aseg.size(); ++i) {
+        am[i] = (int32_t)(rows->aseg[i] / R);
+        aa[i] = (int32_t)(rows->aseg[i] % R);
+        ac[i] = rows->aval[i];
+    }
+    for (size_t i = 0; i < rows->rseg.size(); ++i) {
+        dm[i] = (int32_t)(rows->rseg[i] / R);
+        da[i] = (int32_t)(rows->rseg[i] % R);
+        dc[i] = rows->rval[i];
+    }
+    delete rows;
+    return 0;
+}
+
+void orset_fold_rows_drop(void* handle) { delete (FoldRows*)handle; }
+
 int orset_fresh_fold(const int8_t* kind, const int32_t* member,
                      const int32_t* actor, const int32_t* counter, int64_t n,
                      int64_t E, int64_t R, int32_t* clock,

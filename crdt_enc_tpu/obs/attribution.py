@@ -49,8 +49,9 @@ _STREAM_STAGES: dict[str, tuple[tuple[str, ...], ...]] = {
     "h2d": (("stream.h2d",),),
     "fold": (("stream.reduce", "ops.bulk_fold", "ops.chunk_fold",
               "session.device_fold", "session.host_reduce",
-              "fold.device", "ops.fold"),),
-    "scatter": (("stream.finish", "session.writeback",
+              "fold.device", "ops.fold"),
+             ("session.sparse_fold",)),
+    "scatter": (("session.writeback", "stream.finish",
                  "fold.writeback"), ("stream.d2h",)),
     "seal": (("compact.seal",), ("compact.write",), ("compact.gc",),
              ("checkpoint.save",), ("delta.seal",), ("delta.verify",)),

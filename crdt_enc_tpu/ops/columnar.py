@@ -26,7 +26,7 @@ import numpy as np
 from ..models import AddOp, ORSet, RmOp, VClock
 from ..models.counters import NEG, POS
 from ..models.vclock import Dot
-from ..utils import codec
+from ..utils import codec, trace
 
 logger = logging.getLogger("crdt_enc_tpu.columnar")
 
@@ -66,6 +66,18 @@ def pad_orset_rows(cols: "OrsetColumns", target: int, num_replicas: int):
         )
         cols.counter = np.concatenate([cols.counter, np.zeros(padn, np.int32)])
     return cols
+
+
+def strictly_sorted(seq) -> bool:
+    """True iff ``seq`` is strictly ascending (⇒ unique).  C-level
+    pairwise compare — ~3ms at 100k byte-string actors vs ~10ms for an
+    index-based genexp; this sits ahead of every bulk ingest, where a
+    storage listing that is already the sorted actor table lets callers
+    skip a set union + re-sort of 100k keys."""
+    import operator
+    from itertools import islice
+
+    return all(map(operator.lt, seq, islice(seq, 1, None)))
 
 
 class Vocab:
@@ -174,6 +186,11 @@ def orset_scan_vocab(state: ORSet, members: Vocab, replicas: Vocab) -> None:
     ones append in sorted order (deterministic), instead of one ``intern``
     call per dot — at ~1M dots the per-dot Python calls cost ~0.5s of
     every warm-open tail ingest and every fold's vocab pass."""
+    if not state.entries and not state.deferred and not state.clock.counters:
+        # an empty state mentions nothing — in particular do NOT touch
+        # ``replicas.index``, whose lazy build over a 100k-actor table
+        # costs ~10ms and is pure waste on the fresh streaming shape
+        return
     actor_set: set = set()
     for m, entry in state.entries.items():
         members.intern(m)
@@ -360,14 +377,31 @@ def orset_fold_sparse_host(
     )
 
 
+#: rows below this skip the checkpoint-stash bookkeeping — repacking a
+#: tiny state from its dicts costs less than carrying the row arrays
+CKPT_STASH_MIN_ROWS = 4096
+
+
 def _orset_fresh_fold_native(
     state, kind, member, actor, counter, members, replicas, clock0
 ):
-    """Attempt the native fresh-state sparse fold (statebuild.cpp):
-    packed-u64 radix sort + C-API dict assembly, byte-identical to the
-    numpy/Python path below.  Returns the folded state, or None when the
-    native library is unavailable or the shape overflows the packed
-    sort (caller falls through to the Python path)."""
+    """Attempt the native fresh-state sparse fold (statebuild.cpp),
+    byte-identical to the numpy/Python path below.  Returns the folded
+    state, or None when the native library is unavailable or the shape
+    overflows the packed sort (caller falls through to the Python path).
+
+    Split protocol (``orset_fold_rows`` → ``grouped_rows_dicts``): the
+    pure-C FOLD — gate + packed-u64 radix sort + dedup + survivor
+    filter — runs under its own ``session.sparse_fold`` span, and the
+    CPython dict WRITEBACK under ``session.writeback``, so the gap
+    report's fold marginal stops absorbing dict-assembly time.  The
+    surviving rows come out member-contiguous in the
+    ``orset_pack_checkpoint`` layout and are stashed on the state
+    (mut-epoch-guarded) so the compaction's warm-open checkpoint seals
+    straight from them — zero dict re-walk (core.py
+    ``_pack_checkpoint_state``).  Falls back to the fused
+    ``orset_fresh_fold`` (one call, dicts built inside) when the split
+    entry points are missing (older .so)."""
     import ctypes
 
     from .. import native
@@ -392,25 +426,144 @@ def _orset_fresh_fold_native(
     clock = np.ascontiguousarray(clock0, np.int32)
     i8p = ctypes.POINTER(ctypes.c_int8)
     i32p = ctypes.POINTER(ctypes.c_int32)
-    rc = lib.orset_fresh_fold(
-        kind.ctypes.data_as(i8p),
-        member32.ctypes.data_as(i32p),
-        actor32.ctypes.data_as(i32p),
-        counter32.ctypes.data_as(i32p),
-        len(kind), E, R,
-        clock.ctypes.data_as(i32p),
-        members.items, replicas.items,
-        state.entries, state.deferred,
-    )
-    if rc == -2:
-        raise RuntimeError("native orset_fresh_fold failed")
-    if rc != 0:
-        return None
-    clock_dict = lib.dense_clock_dict(
-        clock.ctypes.data_as(i32p), R, replicas.items
-    )
-    state.clock = VClock(clock_dict)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    if not hasattr(lib, "orset_fold_rows"):
+        # stale .so without the split protocol: fused fold+writeback
+        rc = lib.orset_fresh_fold(
+            kind.ctypes.data_as(i8p),
+            member32.ctypes.data_as(i32p),
+            actor32.ctypes.data_as(i32p),
+            counter32.ctypes.data_as(i32p),
+            len(kind), E, R,
+            clock.ctypes.data_as(i32p),
+            members.items, replicas.items,
+            state.entries, state.deferred,
+        )
+        if rc == -2:
+            raise RuntimeError("native orset_fresh_fold failed")
+        if rc != 0:
+            return None
+        clock_dict = lib.dense_clock_dict(
+            clock.ctypes.data_as(i32p), R, replicas.items
+        )
+        state.clock = VClock(clock_dict)
+        return state
+    with trace.span("session.sparse_fold"):
+        counts = np.zeros(2, np.int64)
+        handle = lib.orset_fold_rows(
+            kind.ctypes.data_as(i8p),
+            member32.ctypes.data_as(i32p),
+            actor32.ctypes.data_as(i32p),
+            counter32.ctypes.data_as(i32p),
+            len(kind), E, R,
+            clock.ctypes.data_as(i32p),
+            counts.ctypes.data_as(i64p),
+        )
+        if not handle:
+            return None  # packed-sort overflow / alloc failure
+        n_a, n_d = int(counts[0]), int(counts[1])
+        taken = False
+        try:
+            am = np.zeros(n_a, np.int32)
+            aa = np.zeros(n_a, np.int32)
+            ac = np.zeros(n_a, np.int64)
+            dm = np.zeros(n_d, np.int32)
+            da = np.zeros(n_d, np.int32)
+            dc = np.zeros(n_d, np.int64)
+            taken = True  # take() frees even if a later copy would fail
+            rc = lib.orset_fold_rows_take(
+                handle,
+                am.ctypes.data_as(i32p), aa.ctypes.data_as(i32p),
+                ac.ctypes.data_as(i64p), n_a,
+                dm.ctypes.data_as(i32p), da.ctypes.data_as(i32p),
+                dc.ctypes.data_as(i64p), n_d,
+            )
+            if rc != 0:
+                raise RuntimeError(
+                    "orset_fold_rows_take capacity mismatch"
+                )
+        finally:
+            if not taken:  # e.g. MemoryError sizing the output arrays
+                lib.orset_fold_rows_drop(handle)
+    with trace.span("session.writeback"):
+        if n_a and not _grouped_rows_dicts_native(
+            am, aa, ac, members.items, replicas.items, state.entries
+        ):
+            _fill_dicts_from_rows(
+                am, aa, ac, members, replicas, state.entries
+            )
+        if n_d and not _grouped_rows_dicts_native(
+            dm, da, dc, members.items, replicas.items, state.deferred
+        ):
+            _fill_dicts_from_rows(
+                dm, da, dc, members, replicas, state.deferred
+            )
+        clock_dict = lib.dense_clock_dict(
+            clock.ctypes.data_as(i32p), R, replicas.items
+        )
+        state.clock = VClock(clock_dict)
+    if n_a + n_d >= CKPT_STASH_MIN_ROWS:
+        state._ckpt_rows = (
+            getattr(state, "_mut", None),
+            (clock.copy(), am, aa, ac, dm, da, dc, members, replicas),
+        )
     return state
+
+
+def _fill_dicts_from_rows(m_idx, a_idx, ctr, members: Vocab,
+                          replicas: Vocab, target: dict) -> None:
+    """Python fallback for the member-contiguous rows → nested-dicts
+    writeback (the ``grouped_rows_dicts`` contract) — byte-identical."""
+    a_l = a_idx.tolist()
+    c_l = ctr.tolist()
+    starts = np.flatnonzero(np.r_[True, np.diff(m_idx) != 0])
+    ends = np.r_[starts[1:], len(m_idx)]
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        target[members.items[int(m_idx[s])]] = {
+            replicas.items[a_l[t]]: c_l[t] for t in range(s, e)
+        }
+
+
+def orset_pack_checkpoint_rows(
+    clock: np.ndarray, am, aa, ac, dm, da, dc,
+    members: Vocab, replicas: Vocab,
+) -> dict:
+    """:func:`orset_pack_checkpoint` computed from the fresh fold's
+    surviving ROW columns (``_orset_fresh_fold_native``'s stash) — the
+    zero-copy decode→planes tail: the checkpoint payload falls out of
+    vectorized index remaps over arrays the fold already produced, with
+    no walk of the dicts the state also materialized.  Same wire keys
+    and invariants as the sparse pack (clock actors first and aligned
+    with ``cc``, member groups contiguous, only referenced objects
+    listed); table/row ORDER may differ from the dict walk — legal, the
+    checkpoint is a local cache and ``orset_unpack_checkpoint`` is
+    order-agnostic beyond group contiguity (the
+    ``orset_pack_checkpoint_planes`` precedent)."""
+    clock = np.asarray(clock)
+    cnz = np.nonzero(clock)[0]
+    used = np.union1d(np.union1d(cnz, aa), da)
+    a_order = np.concatenate([cnz, np.setdiff1d(used, cnz)])
+    a_perm = np.zeros((int(a_order.max()) + 1) if len(a_order) else 1,
+                      np.int32)
+    a_perm[a_order] = np.arange(len(a_order), dtype=np.int32)
+    em = np.unique(am)
+    m_order = np.concatenate([em, np.setdiff1d(np.unique(dm), em)])
+    m_perm = np.zeros((int(m_order.max()) + 1) if len(m_order) else 1,
+                      np.int32)
+    m_perm[m_order] = np.arange(len(m_order), dtype=np.int32)
+    aobj, mobj = replicas.items, members.items
+    return {
+        b"actors": [aobj[int(i)] for i in a_order],
+        b"members": [mobj[int(i)] for i in m_order],
+        b"nc": len(cnz),
+        b"cc": clock[cnz].astype(np.int64).tobytes(),
+        b"em": m_perm[am].tobytes(),
+        b"ea": a_perm[aa].tobytes(),
+        b"ec": np.asarray(ac, np.int64).tobytes(),
+        b"dm": m_perm[dm].tobytes(),
+        b"da": a_perm[da].tobytes(),
+        b"dc": np.asarray(dc, np.int64).tobytes(),
+    }
 
 
 def orset_apply_coo(
@@ -640,36 +793,20 @@ def orset_pack_checkpoint_planes(
     pinned semantically in tests.  Planes may be bucket-padded: padded
     cells are zero, so no index past the vocabularies can appear.
     Counters are int32 by plane construction, so the sparse pack's
-    int64-overflow decline cannot arise."""
+    int64-overflow decline cannot arise.
+
+    Implementation: ``np.nonzero`` flattens the planes to the entry /
+    deferred row columns (row-major ⇒ member-contiguous), then the ONE
+    row-layout packer (:func:`orset_pack_checkpoint_rows`) builds the
+    payload — the two plane/row entry points cannot drift."""
     clock = np.asarray(clock)
     add = np.asarray(add)
     rm = np.asarray(rm)
-    cnz = np.nonzero(clock)[0]
     es, rs = np.nonzero(add)
     ds, qs = np.nonzero(rm)
-    used = np.union1d(np.union1d(cnz, rs), qs)
-    a_order = np.concatenate([cnz, np.setdiff1d(used, cnz)])
-    a_perm = np.zeros((int(a_order.max()) + 1) if len(a_order) else 1,
-                      np.int32)
-    a_perm[a_order] = np.arange(len(a_order), dtype=np.int32)
-    em = np.unique(es)
-    m_order = np.concatenate([em, np.setdiff1d(np.unique(ds), em)])
-    m_perm = np.zeros((int(m_order.max()) + 1) if len(m_order) else 1,
-                      np.int32)
-    m_perm[m_order] = np.arange(len(m_order), dtype=np.int32)
-    aobj, mobj = replicas.items, members.items
-    return {
-        b"actors": [aobj[int(i)] for i in a_order],
-        b"members": [mobj[int(i)] for i in m_order],
-        b"nc": len(cnz),
-        b"cc": clock[cnz].astype(np.int64).tobytes(),
-        b"em": m_perm[es].tobytes(),
-        b"ea": a_perm[rs].tobytes(),
-        b"ec": add[es, rs].astype(np.int64).tobytes(),
-        b"dm": m_perm[ds].tobytes(),
-        b"da": a_perm[qs].tobytes(),
-        b"dc": rm[ds, qs].astype(np.int64).tobytes(),
-    }
+    return orset_pack_checkpoint_rows(
+        clock, es, rs, add[es, rs], ds, qs, rm[ds, qs], members, replicas
+    )
 
 
 # ---- counters ------------------------------------------------------------

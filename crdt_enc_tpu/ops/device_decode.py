@@ -1,0 +1,247 @@
+"""Device-side op decode — the ``CRDT_DEVICE_DECODE=1`` experiment.
+
+ROADMAP item 1 asks whether the op-file decode belongs ON DEVICE: after
+bulk AEAD the cleartext is a dense byte stream whose canonical
+msgpack-subset framing is *fixed-width* for the overwhelmingly common
+op shape, so the field extraction is pure strided gather + integer
+bit-twiddling — exactly what an accelerator does at memory bandwidth,
+and it would let the decode ride under the fold like the H2D transfers
+already do.
+
+Scope: the **fixed-stride add op** — the canonical encoding of
+``[KIND_ADD, member, [actor16, counter]]`` with a positive-fixint
+member and counter::
+
+    0x93 0x00 <member> 0x92 0xc4 0x10 <actor · 16 bytes> <counter>
+
+i.e. 23 bytes per op, preceded per payload by the canonical array
+header (fixarray or array16).  A chunk qualifies only when EVERY
+payload is a pure run of such ops (host-side vectorized validation —
+one strided numpy pass, no Python per op); anything else returns None
+and the caller uses the native host decoder.  Removes, wide counters,
+and non-fixint members are deliberately out of scope: the experiment
+measures the best case for the device, and the host decoder keeps the
+general case.
+
+The device kernel (:func:`decode_adds_device`) uploads the cleartext
+buffer once (h2d accounted), gathers member/counter bytes and the
+16-byte actor as two big-endian u64 lanes with ``jnp.take``, and pulls
+the four small result columns back.  Actor-lane → table-index
+resolution stays host-side (a 128-bit searchsorted has no single-array
+device form); it is vectorized numpy over the sorted actor table.
+
+**Honest verdict** (bench.py ``--device-decode``, this box: CPU backend,
+1 core — "device" is the same silicon): the gather kernel pays dispatch
++ transfer and loses to the native C walk ~4.8× at the 200k-op shape
+(the committed BENCH_LOCAL record).  The experiment stays committed
+behind the env flag
+as the measurement harness for a real TPU round, where the transfer
+already happens (the fold needs the rows on device) and the gathers are
+HBM-bandwidth work; docs/streaming_pipeline.md records the numbers.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..utils import trace
+
+#: fixed-stride add-op width (bytes) — module docstring layout
+OP_STRIDE = 23
+
+
+def device_decode_enabled() -> bool:
+    return os.environ.get("CRDT_DEVICE_DECODE", "") == "1"
+
+
+def _op_bases(buf: np.ndarray, offs: np.ndarray):
+    """Per-op base offsets for a packed payload buffer, or None when any
+    payload is not a pure fixed-stride add run.  Vectorized: header
+    classification, length validation, and the constant-byte checks all
+    run as strided numpy passes."""
+    n_payloads = len(offs) - 1
+    if n_payloads == 0 or len(buf) == 0:
+        return None
+    starts = offs[:-1].astype(np.int64)
+    lens = np.diff(offs).astype(np.int64)
+    if (lens < 1).any():
+        return None
+    if len(buf) > 2**31 - 1:
+        return None  # the device gather indexes with int32 lanes
+    first = buf[starts]
+    fix = (first & 0xF0) == 0x90
+    a16 = first == 0xDC
+    if not (fix | a16).all():
+        return None
+    hdr = np.where(fix, 1, 3)
+    if (lens < hdr).any():
+        return None
+    counts = np.where(fix, first & 0x0F, 0).astype(np.int64)
+    if a16.any():
+        i = starts[a16]
+        if (i + 2 >= offs[-1]).any():
+            return None
+        counts[a16] = (
+            buf[i + 1].astype(np.int64) << 8
+        ) | buf[i + 2].astype(np.int64)
+    if (lens != hdr + OP_STRIDE * counts).any():
+        return None
+    total = int(counts.sum())
+    if total == 0:
+        return None
+    # grouped arange: base[i] = payload_start + hdr + 23 * (op index
+    # within payload), flattened across payloads in one cumsum trick
+    op_starts = np.repeat(starts + hdr, counts)
+    ends = np.cumsum(counts)
+    within = np.arange(total, dtype=np.int64) - np.repeat(
+        ends - counts, counts
+    )
+    base = op_starts + OP_STRIDE * within
+    # constant-byte + fixint validation, one gather each
+    if (buf[base] != 0x93).any() or (buf[base + 1] != 0x00).any():
+        return None
+    if (buf[base + 3] != 0x92).any() or (buf[base + 4] != 0xC4).any():
+        return None
+    if (buf[base + 5] != 0x10).any():
+        return None
+    if (buf[base + 2] > 0x7F).any() or (buf[base + 22] > 0x7F).any():
+        return None
+    return base
+
+
+def _resolve_actors(hi: np.ndarray, lo: np.ndarray, actors_sorted: list):
+    """Rows' (hi, lo) big-endian actor lanes → indices into the sorted
+    16-byte actor table, or None when any actor is unknown.  Vectorized
+    two-stage searchsorted (hi first, lo refines the rare hi-collision
+    runs)."""
+    R = len(actors_sorted)
+    if R == 0:
+        return None
+    try:
+        table = np.frombuffer(
+            b"".join(actors_sorted), np.uint8
+        ).reshape(R, 16)
+    except (TypeError, ValueError):
+        # non-bytes or non-16-byte actor ids in the table: this corpus
+        # cannot resolve here — decline to the host decoder (the
+        # module's contract), never crash the fold
+        return None
+    w = (256 ** np.arange(7, -1, -1, dtype=np.uint64)).astype(np.uint64)
+    t_hi = (table[:, :8].astype(np.uint64) * w).sum(axis=1, dtype=np.uint64)
+    t_lo = (table[:, 8:].astype(np.uint64) * w).sum(axis=1, dtype=np.uint64)
+    idx = np.searchsorted(t_hi, hi)
+    if (idx >= R).any():
+        return None
+    ok = t_hi[idx] == hi
+    if not ok.all():
+        return None
+    exact = t_lo[idx] == lo
+    if not exact.all():
+        # hi collision (distinct actors sharing 8 leading bytes): walk
+        # the tied run per affected row — rare by construction (uuid4)
+        bad = np.flatnonzero(~exact)
+        for r in bad.tolist():
+            j = int(idx[r])
+            while j < R and t_hi[j] == hi[r] and t_lo[j] != lo[r]:
+                j += 1
+            if j >= R or t_hi[j] != hi[r] or t_lo[j] != lo[r]:
+                return None
+            idx[r] = j
+    return idx.astype(np.int32)
+
+
+def decode_adds_device(packed, actors_sorted: list):
+    """Decode a packed cleartext chunk of fixed-stride add ops on
+    device.  ``packed`` is the ``(buffer, offsets)`` pair the batch
+    decrypt emits.  Returns the 6-tuple the fold-session remap consumes
+    — ``(kind, member_idx, actor_idx, counter, members, member_bytes)``
+    — or None when the chunk does not qualify (caller falls back to the
+    native host decoder; this is the expected path for anything but the
+    all-adds benchmark corpus)."""
+    buf, offs = packed
+    buf = np.frombuffer(buf, np.uint8) if not isinstance(
+        buf, np.ndarray
+    ) else buf
+    base = _op_bases(buf, np.asarray(offs))
+    if base is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    with trace.span("device.decode"):
+        # one upload of the cleartext + the gather index column; h2d
+        # accounted at issue (the fold would re-upload rows anyway — on
+        # a real TPU this transfer replaces that one)
+        trace.add("h2d_bytes", buf.nbytes + base.nbytes)
+        dbuf = jax.device_put(buf)
+        dbase = jax.device_put(base.astype(np.int32))
+        member = jnp.take(dbuf, dbase + 2).astype(jnp.int32)
+        counter = jnp.take(dbuf, dbase + 22).astype(jnp.int32)
+        # the 16 actor bytes fold to FOUR big-endian u32 words on
+        # device (default jax has no 64-bit lanes — uint64 would
+        # silently truncate); the host pairs them into (hi, lo) u64
+        actor_mat = jnp.take(
+            dbuf, dbase[:, None] + (6 + jnp.arange(16))[None, :]
+        ).astype(jnp.uint32)
+        w4 = jnp.asarray(  # lint: disable=OBS001 — 4 constant words
+            [1 << 24, 1 << 16, 1 << 8, 1], jnp.uint32
+        )
+        words = (
+            actor_mat.reshape(-1, 4, 4) * w4[None, None, :]
+        ).sum(axis=2, dtype=jnp.uint32)
+        member, counter, words = (
+            np.asarray(member), np.asarray(counter), np.asarray(words),
+        )
+    w64 = words.astype(np.uint64)
+    hi = (w64[:, 0] << np.uint64(32)) | w64[:, 1]
+    lo = (w64[:, 2] << np.uint64(32)) | w64[:, 3]
+    actor_idx = _resolve_actors(hi, lo, actors_sorted)
+    if actor_idx is None:
+        return None
+    uniq, member_idx = np.unique(member, return_inverse=True)
+    members = [int(v) for v in uniq.tolist()]
+    member_bytes = [bytes([v]) for v in uniq.tolist()]
+    kind = np.zeros(len(base), np.int8)
+    return (
+        kind, member_idx.astype(np.int32), actor_idx,
+        counter.astype(np.int32), members, member_bytes,
+    )
+
+
+def decode_adds_host(packed, actors_sorted: list):
+    """The same fixed-stride extraction with numpy on host — the
+    experiment's control arm: identical eligibility, identical output,
+    no device round-trip.  (The PRODUCT host path is the native C
+    decoder in ops/native_decode.py, which also handles the general
+    framing; this exists so the bench isolates "where does the gather
+    run" from "who parses msgpack".)"""
+    buf, offs = packed
+    buf = np.frombuffer(buf, np.uint8) if not isinstance(
+        buf, np.ndarray
+    ) else buf
+    base = _op_bases(buf, np.asarray(offs))
+    if base is None:
+        return None
+    member = buf[base + 2].astype(np.int32)
+    counter = buf[base + 22].astype(np.int32)
+    w = (256 ** np.arange(7, -1, -1, dtype=np.uint64)).astype(np.uint64)
+    actor_mat = buf[base[:, None] + (6 + np.arange(16))[None, :]]
+    hi = (actor_mat[:, :8].astype(np.uint64) * w).sum(
+        axis=1, dtype=np.uint64
+    )
+    lo = (actor_mat[:, 8:].astype(np.uint64) * w).sum(
+        axis=1, dtype=np.uint64
+    )
+    actor_idx = _resolve_actors(hi, lo, actors_sorted)
+    if actor_idx is None:
+        return None
+    uniq, member_idx = np.unique(member, return_inverse=True)
+    members = [int(v) for v in uniq.tolist()]
+    member_bytes = [bytes([v]) for v in uniq.tolist()]
+    kind = np.zeros(len(base), np.int8)
+    return (
+        kind, member_idx.astype(np.int32), actor_idx,
+        counter.astype(np.int32), members, member_bytes,
+    )

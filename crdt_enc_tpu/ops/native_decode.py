@@ -165,10 +165,20 @@ def decode_orset_payload_spans(payloads, actors_sorted: list, cache=None):
     return buf, kind, moff, mlen, actor, counter
 
 
-def combine_orset_spans(parts: list):
+def combine_orset_spans(parts: list, *, with_bytes: bool = False):
     """Concatenate span chunks from ``decode_orset_payload_spans`` and
     intern the member spans once.  Returns the same tuple as
-    ``decode_orset_payload_batch``."""
+    ``decode_orset_payload_batch``; with ``with_bytes`` a sixth element
+    carries each unique member's WIRE bytes (the interning key), so a
+    session-level remap can recognize an already-seen member with one
+    bytes-dict hit instead of an object intern + canonical re-pack per
+    chunk."""
+    if not parts:
+        kind = np.zeros(0, np.int8)
+        actor = counter = np.zeros(0, np.int32)
+        if with_bytes:
+            return kind, np.zeros(0, np.int32), actor, counter, [], []
+        return kind, np.zeros(0, np.int32), actor, counter, []
     if len(parts) == 1:
         buf, kind, moff, mlen, actor, counter = parts[0]
     else:
@@ -182,19 +192,31 @@ def combine_orset_spans(parts: list):
         actor = np.concatenate([p[4] for p in parts])
         counter = np.concatenate([p[5] for p in parts])
     if len(kind) == 0:
+        if with_bytes:
+            return kind, np.zeros(0, np.int32), actor, counter, [], []
         return kind, np.zeros(0, np.int32), actor, counter, []
+    if with_bytes:
+        member_idx, members, member_bytes = intern_spans(
+            buf, moff, mlen, return_bytes=True
+        )
+        return kind, member_idx, actor, counter, members, member_bytes
     member_idx, members = intern_spans(buf, moff, mlen)
     return kind, member_idx, actor, counter, members
 
 
-def intern_spans(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
+def intern_spans(buf: np.ndarray, off: np.ndarray, length: np.ndarray,
+                 *, return_bytes: bool = False):
     """Span interning: rows → dense member indices + decoded unique member
     objects.  The native open-addressing hash pass costs one linear scan
     (the numpy fallback below sorts 8 bytes per row — measured ~8× slower
     at the 8M-row e2e scale); unique spans then decode via codec, a few
-    thousand objects at most."""
+    thousand objects at most.  ``return_bytes`` adds the unique spans'
+    raw wire bytes as a third element (one small ``bytes`` per unique
+    member — the caller's cross-chunk dedup key)."""
     n = len(off)
     if n == 0:
+        if return_bytes:
+            return np.zeros(0, np.int32), [], []
         return np.zeros(0, np.int32), []
     if (np.asarray(length) == 0).any():
         raise ValueError("empty member span")
@@ -219,16 +241,36 @@ def intern_spans(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
     except RuntimeError:  # native lib unavailable
         got = -1
     if got >= 0:
+        if return_bytes:
+            # bytes-only mode: do NOT decode the unique spans — the
+            # session remap recognizes seen spans by bytes and decodes
+            # only genuinely new members (codec.unpack per distinct
+            # member per STREAM, not per chunk — measured ~10ms of the
+            # config-5 wall as pure re-decode of already-known members)
+            mv = memoryview(np.ascontiguousarray(buf))
+            spans = [
+                bytes(mv[int(o) : int(o) + int(ln)])
+                for o, ln in zip(
+                    uniq_off[:got].tolist(), uniq_len[:got].tolist()
+                )
+            ]
+            return idx, None, spans
         mv = memoryview(np.ascontiguousarray(buf))
         members = [
             codec.unpack(mv[int(o) : int(o) + int(ln)])
             for o, ln in zip(uniq_off[:got].tolist(), uniq_len[:got].tolist())
         ]
         return idx, members
+    if return_bytes:
+        idx, members, spans = _intern_spans_numpy(
+            buf, off, length, return_bytes=True
+        )
+        return idx, members, spans
     return _intern_spans_numpy(buf, off, length)
 
 
-def _intern_spans_numpy(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
+def _intern_spans_numpy(buf: np.ndarray, off: np.ndarray, length: np.ndarray,
+                        *, return_bytes: bool = False):
     """Vectorized fallback: groups rows by span length; spans of ≤ 8 bytes
     (the overwhelmingly common case — small ints, short bytes) pack into
     uint64 so ``np.unique`` sorts scalars (~10× faster than the byte-matrix
@@ -237,6 +279,7 @@ def _intern_spans_numpy(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
     n = len(off)
     member_idx = np.zeros(n, np.int32)
     members: list = []
+    spans: list = []
     off = off.astype(np.int64)
     length = length.astype(np.int64)
     for L in np.unique(length):
@@ -257,14 +300,18 @@ def _intern_spans_numpy(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
                 packed = (packed << np.uint64(8)) | mat[:, b].astype(np.uint64)
             uniq, inv = np.unique(packed, return_inverse=True)
             for u in uniq:
-                members.append(
-                    codec.unpack(int(u).to_bytes(Li, "big"))
-                )
+                raw = int(u).to_bytes(Li, "big")
+                members.append(codec.unpack(raw))
+                spans.append(raw)
         else:
             uniq, inv = np.unique(mat, axis=0, return_inverse=True)
             for u in uniq:
-                members.append(codec.unpack(u.tobytes()))
+                raw = u.tobytes()
+                members.append(codec.unpack(raw))
+                spans.append(raw)
         member_idx[sel] = base + inv.astype(np.int32)
+    if return_bytes:
+        return member_idx, members, spans
     return member_idx, members
 
 

@@ -649,19 +649,25 @@ class TpuAccelerator(HostAccelerator):
         out, with the host stages running CONCURRENTLY with the fold.
 
         ``n_producers`` worker threads (0 = the accelerator's configured
-        ``stream_producers``, itself 0 = auto from the core count) run
-        threaded native decrypt (``decrypt_blobs_packed``) + native
-        columnar decode for upcoming chunks while this thread
-        columnarizes and folds the current one through a fold session
-        (parallel/session.py — BUFFER / HOST_REDUCE / DEVICE_STREAM by
-        regime; the device mode issues chunk H2D under the in-flight
-        donated fold, mesh-sharded when the accelerator's
-        ``sharded_stream`` route is active).  A sequencer re-emits
-        chunks in chunk-index order, so the folded bytes are identical
-        at any producer count.  Backpressure bounds live host memory to
-        ``depth`` chunks (0 = producers + 1; ops/stream.py
-        ``run_ingest_pipeline``).  Per-stage trace spans
-        (``stream.decrypt`` / ``stream.decode`` / ``stream.ingest`` /
+        ``stream_producers``, itself 0 = auto from the core count) claim
+        **file-granular stripes** off one unified work queue
+        (ops/stream.py ``run_striped_ingest_pipeline``): each stripe is
+        a byte-bounded file subrange of a chunk, decrypted natively
+        single-threaded (the old per-chunk decrypt thread pool is gone —
+        parallelism lives entirely in the pool, never threads ×
+        threads), and the worker landing a chunk's last stripe runs its
+        columnar decode, while this thread columnarizes and folds
+        completed chunks through a fold session (parallel/session.py —
+        BUFFER / HOST_REDUCE / DEVICE_STREAM by regime; the device mode
+        issues chunk H2D under the in-flight donated fold, mesh-sharded
+        when the accelerator's ``sharded_stream`` route is active).  A
+        sequencer re-emits chunks in chunk-index order, so the folded
+        bytes are identical at any producer count and any stripe split.
+        Backpressure bounds live host memory to ``depth`` chunks (0 =
+        producers + 1).  On a single-core host with one producer the
+        pipeline runs inline (no threads — byte-identical, minus the
+        queue overhead).  Per-stage trace spans (``stream.decrypt`` /
+        ``stream.decode`` / ``stream.stripe`` / ``stream.ingest`` /
         ``stream.reduce`` / ``stream.finish``, plus the fan-out's
         ``stream.producer.wait`` / ``stream.sequence`` and the
         ``stream_producers`` gauge) make the overlap auditable;
@@ -674,7 +680,9 @@ class TpuAccelerator(HostAccelerator):
         pipeline faults raise.
         """
         from ..backends.xchacha import decrypt_blobs, decrypt_blobs_packed
-        from ..ops.stream import run_ingest_pipeline, stream_producer_count
+        from ..ops.stream import (
+            run_striped_ingest_pipeline, stream_producer_count,
+        )
         from .session import SessionDeclined
 
         session = self.open_fold_session(state, actors_hint=actors_hint)
@@ -690,40 +698,82 @@ class TpuAccelerator(HostAccelerator):
         producers = stream_producer_count(
             n_producers if n_producers > 0 else self.stream_producers
         )
-        # each producer already owns a whole chunk: with several of them
-        # the parallelism is ACROSS chunks, so the in-chunk decrypt pool
-        # drops to one thread each — N single-threaded decrypt streams
-        # instead of one N-threaded one (same silicon, no oversubscribe)
-        chunk_threads = n_threads if n_threads else (1 if producers > 1 else 0)
+        # with N > 1 every decrypt call is single-threaded: the
+        # parallelism lives entirely in the producer pool's
+        # file-granular stripe claiming — N cooperating decrypt lanes
+        # on one unified queue, never threads × threads.  A SINGLE
+        # producer keeps the native batch call's own thread pool (0 =
+        # auto from the core count) — one whole-chunk stripe with no
+        # pool of its own would strand a multicore box's idle cores.
+        stripe_threads = n_threads if n_threads else (
+            0 if producers == 1 else 1
+        )
 
         accepts_packed = getattr(session, "accepts_packed", False)
 
-        def ingest(span, k):
+        def split(span, k):
+            """File-granular stripes: with several producers a chunk
+            splits at byte boundaries so one giant op file forms its own
+            stripe (one worker) while its peers decrypt the rest — a
+            whole-chunk lane can no longer serialize behind it."""
+            if producers == 1 or len(span) <= 1:
+                return [span] if span else []
+            budget = max(1, sum(len(b) for b in span) // producers)
+            stripes, cur, cur_bytes = [], [], 0
+            for b in span:
+                cur.append(b)
+                cur_bytes += len(b)
+                if cur_bytes >= budget:
+                    stripes.append(cur)
+                    cur, cur_bytes = [], 0
+            if cur:
+                stripes.append(cur)
+            return stripes
+
+        def stripe(files, k, s):
             with trace.span("stream.decrypt", meta=k):
-                payloads = decrypt_blobs_packed(key, span, chunk_threads)
-                if payloads is None:
-                    payloads = decrypt_blobs(key, span, chunk_threads)
-                elif not accepts_packed:
-                    # span-decoder-less sessions (counters, maps) take
-                    # per-blob views of the shared cleartext buffer
-                    out, offs = payloads
-                    view = memoryview(out)
-                    lo_hi = offs.tolist()
-                    payloads = [
-                        view[int(lo_hi[i]) : int(lo_hi[i + 1])]
-                        for i in range(len(lo_hi) - 1)
-                    ]
+                packed = decrypt_blobs_packed(key, files, stripe_threads)
+                if packed is None:
+                    packed = decrypt_blobs(key, files, stripe_threads)
+                # counted only AFTER the stripe's decrypt succeeded
+                # (AeadError raises above) — the attribution marginals
+                # must never claim bytes a failed batch never opened
+                trace.add(
+                    "bytes_decrypted", sum(len(b) for b in files)
+                )
+                return packed
+
+        def assemble(parts, span, k):
+            if not accepts_packed:
+                # span-decoder-less sessions (counters, maps) take
+                # per-blob views of the shared cleartext buffers
+                payloads: list = []
+                for part in parts:
+                    if isinstance(part, tuple):
+                        out, offs = part
+                        view = memoryview(out)
+                        lo_hi = offs.tolist()
+                        payloads.extend(
+                            view[int(lo_hi[i]) : int(lo_hi[i + 1])]
+                            for i in range(len(lo_hi) - 1)
+                        )
+                    else:
+                        payloads.extend(part)
+                with trace.span("stream.decode", meta=k):
+                    return session.decode_chunk(payloads)
             with trace.span("stream.decode", meta=k):
-                # thread-safe by contract: decode_chunk never mutates
-                # the session (parallel/session.py)
-                return session.decode_chunk(payloads)
+                # thread-safe by contract: decode never mutates the
+                # session (parallel/session.py); multi-part decode
+                # combines the per-stripe cleartext buffers zero-copy
+                return session.decode_chunk_parts(parts)
 
         def reduce(decoded, k):
             session.reduce_chunk(decoded)
 
         try:
-            run_ingest_pipeline(
-                spans, ingest, reduce, depth=depth, producers=producers
+            run_striped_ingest_pipeline(
+                spans, split, stripe, assemble, reduce,
+                depth=depth, producers=producers,
             )
             with trace.span("stream.finish"):
                 session.finish()

@@ -107,37 +107,63 @@ class OrsetFoldSession:
     accepts_packed = True
 
     def __init__(self, accel, state: ORSet, actors_hint=()):
+        from ..ops.columnar import strictly_sorted
+
         self.accel = accel
         self.state = state
-        # one pass over the state builds BOTH vocabularies: actors via
-        # C-level set.update per entry dict, members in first-appearance
-        # order (entries, then deferred) — a per-dot intern walk here
-        # cost ~0.5s of every warm-open tail ingest at 1M-dot states
-        actor_set = set(actors_hint)
-        actor_set.update(state.clock.counters)
-        member_list = []
-        for m, entry in state.entries.items():
-            member_list.append(m)
-            actor_set.update(entry)
-        for m, dfr in state.deferred.items():
-            member_list.append(m)
-            actor_set.update(dfr)
-        self.actors_sorted = sorted(actor_set)
-        self.replicas = K.Vocab(self.actors_sorted)
+        clock_counters = state.clock.counters
+        fresh = (
+            not clock_counters and not state.entries and not state.deferred
+        )
+        if fresh and strictly_sorted(actors_hint):
+            # the streaming shape — a FRESH replica whose actor hint is
+            # already the sorted table (storage listings are sorted):
+            # the hint IS actors_sorted, the clock is all zeros, and the
+            # Vocab index builds lazily.  The general path below cost
+            # ~77ms of a ~150ms e2e streaming wall at the config-5
+            # shape (100k-actor set union + sort + a 100k-iteration
+            # Python clock loop + two eager index builds) — all of it
+            # provably no-ops on an empty state.
+            self.actors_sorted = list(actors_hint)
+            self.replicas = K.Vocab.presorted_unique(self.actors_sorted)
+            member_list: list = []
+        else:
+            # one pass over the state builds BOTH vocabularies: actors
+            # via C-level set.update per entry dict, members in
+            # first-appearance order (entries, then deferred) — a
+            # per-dot intern walk here cost ~0.5s of every warm-open
+            # tail ingest at 1M-dot states
+            actor_set = set(actors_hint)
+            actor_set.update(clock_counters)
+            member_list = []
+            for m, entry in state.entries.items():
+                member_list.append(m)
+                actor_set.update(entry)
+            for m, dfr in state.deferred.items():
+                member_list.append(m)
+                actor_set.update(dfr)
+            self.actors_sorted = sorted(actor_set)
+            # sorted set ⇒ unique: skip the eager index build too
+            self.replicas = K.Vocab.presorted_unique(self.actors_sorted)
         self.members = K.Vocab()
         for m in member_list:
             self.members.intern(m)
         self._state_members = len(self.members)
         self.R = len(self.replicas)
         # the kernel's stale-add mask is evaluated against the clock as of
-        # session start for EVERY chunk — one-big-batch semantics
+        # session start for EVERY chunk — one-big-batch semantics.  Only
+        # actors the clock actually mentions are visited (zeros
+        # elsewhere), and none are on the fresh fast path.
         self._clock0 = np.zeros(max(self.R, 1), np.int32)
-        for i, a in enumerate(self.actors_sorted):
-            self._clock0[i] = state.clock.get(a)
+        if clock_counters:
+            index = self.replicas.index
+            for a, c in clock_counters.items():
+                self._clock0[index[a]] = c
         self.mode = "buffer"
         self._buffered: list[tuple] = []
         self._buffered_bytes = 0
         self._member_canon: dict[int, bytes] = {}
+        self._member_ids: dict[bytes, int] = {}  # wire bytes → member gid
         # actor-table flattening + native hash index, built once per
         # session and reused across chunk decodes (rebuilding per chunk
         # at 100k actors costs more than the decode itself); entries are
@@ -159,27 +185,61 @@ class OrsetFoldSession:
         """Stage 1, thread-safe (no session mutation): native columnar
         decode of one chunk's payloads.  The ctypes call releases the GIL,
         so the core decodes chunk i+1 while chunk i reduces."""
+        return self.decode_chunk_parts([payloads])
+
+    def decode_chunk_parts(self, parts: list):
+        """Multi-part twin of :meth:`decode_chunk`: each element of
+        ``parts`` is one stripe's cleartext — a packed ``(buffer,
+        offsets)`` pair or a payload list — decoded in place and
+        combined zero-copy (the striped pipeline's per-stripe decrypt
+        buffers never re-join).  Thread-safe like ``decode_chunk``."""
         from ..ops.native_decode import (
             combine_orset_spans, decode_orset_payload_spans,
         )
 
-        with trace.span("session.decode"):
-            part = decode_orset_payload_spans(
-                payloads, self.actors_sorted, cache=self._decode_cache
+        if len(parts) == 1 and isinstance(parts[0], tuple):
+            from ..ops.device_decode import (
+                decode_adds_device, device_decode_enabled,
             )
-            if part is None:
-                raise SessionDeclined("native decoder declined the chunk")
-            decoded = combine_orset_spans([part])
+
+            if device_decode_enabled():
+                # the CRDT_DEVICE_DECODE=1 experiment: fixed-stride
+                # add-only chunks bit-twiddle on device after bulk AEAD;
+                # anything else (removes, wide ints) falls through to
+                # the native host decoder below (ops/device_decode.py)
+                dd = decode_adds_device(parts[0], self.actors_sorted)
+                if dd is not None:
+                    return dd
+
+        with trace.span("session.decode"):
+            decoded_parts = []
+            for payloads in parts:
+                part = decode_orset_payload_spans(
+                    payloads, self.actors_sorted, cache=self._decode_cache
+                )
+                if part is None:
+                    raise SessionDeclined(
+                        "native decoder declined the chunk"
+                    )
+                decoded_parts.append(part)
+            decoded = combine_orset_spans(decoded_parts, with_bytes=True)
         return decoded
 
     def reduce_chunk(self, decoded) -> None:
         """Stage 2, serialized by the caller (mutates vocab + planes)."""
         assert not self._finished, "session already finished"
-        kind, member_idx, actor_idx, counter, member_objs = decoded
+        member_bytes = None
+        if len(decoded) == 6:
+            kind, member_idx, actor_idx, counter, member_objs, \
+                member_bytes = decoded
+        else:
+            kind, member_idx, actor_idx, counter, member_objs = decoded
         if len(kind) == 0:
             return
         with trace.span("session.remap"):
-            member_global = self._remap_members(member_idx, member_objs)
+            member_global = self._remap_members(
+                member_idx, member_objs, member_bytes
+            )
         self.rows_fed += len(kind)
         cols = (kind, member_global, actor_idx, counter)
         if self.mode == "buffer":
@@ -196,20 +256,51 @@ class OrsetFoldSession:
         """decode + reduce in one call (single-threaded convenience)."""
         self.reduce_chunk(self.decode_chunk(payloads))
 
-    def _remap_members(self, member_idx, member_objs):
+    def _remap_members(self, member_idx, member_objs, member_bytes=None):
         """Chunk-local member interning → the session-global vocabulary.
-        Python work is one intern + one canonical pack per *distinct*
-        member per chunk; rows remap vectorized.
 
-        Collision guard: distinct canonical bytes can still collide as
-        Python values (1 == True, 0.0 == -0.0) — including ACROSS chunks
-        or against members already in the state.  The dense planes cannot
-        represent that, so each vocab slot remembers the canonical bytes
-        it was first interned under and any mismatch declines the chunk
-        (the per-op path then matches the host dict semantics exactly)."""
+        With ``member_bytes`` (the decoder's unique wire spans) a seen
+        span is ONE bytes-dict hit — no object hashing, no re-pack: the
+        per-chunk Python work drops from one intern + canonical pack per
+        distinct member (measured ~30ms across the config-5 chunks) to
+        effectively zero after the first chunk.  A new span pays one
+        intern + pack exactly like the legacy path.
+
+        Collision guard (both paths): distinct canonical bytes can still
+        collide as Python values (1 == True, 0.0 == -0.0) — including
+        ACROSS chunks or against members already in the state.  The
+        dense planes cannot represent that, so each vocab slot remembers
+        the canonical bytes it was first interned under and any mismatch
+        declines the chunk (the per-op path then matches the host dict
+        semantics exactly).  A NON-canonical wire alias of the same
+        value (e.g. uint8-encoded 5) is accepted and cached per wire
+        span, exactly as the legacy re-pack accepted it."""
         from ..utils import codec
 
         canon = self._member_canon
+        if member_bytes is not None:
+            # member_objs may be None (lazy mode): a new span decodes
+            # HERE, once per distinct member per stream
+            table = np.empty(len(member_bytes), np.int32)
+            ids = self._member_ids
+            for i, pk in enumerate(member_bytes):
+                gid = ids.get(pk)
+                if gid is None:
+                    obj = (
+                        codec.unpack(pk) if member_objs is None
+                        else member_objs[i]
+                    )
+                    gid = self.members.intern(obj)
+                    prev = canon.get(gid)
+                    if prev is None:
+                        stored = self.members.items[gid]
+                        prev = codec.pack(stored)
+                        canon[gid] = prev
+                    if prev != pk and codec.pack(obj) != prev:
+                        raise SessionDeclined("member vocab collision")
+                    ids[pk] = gid
+                table[i] = gid
+            return table[member_idx]
         table = np.empty(len(member_objs), np.int32)
         for i, obj in enumerate(member_objs):
             gid = self.members.intern(obj)

@@ -147,9 +147,12 @@ def test_cli_gap_golden_on_committed_streaming_record(capsys):
     ]) == 0
     out = capsys.readouterr().out
     assert out == (DATA / "obs_gap_golden.txt").read_text()
-    # the two headline facts, asserted independently of the rendering
-    assert "= 10.65x" in out
-    assert "dominant stage: decode" in out
+    # the two headline facts, asserted independently of the rendering —
+    # the ISSUE-13 witness: decode is no longer the dominant stage (the
+    # PR-11 record read 10.65x decode-dominant; the encrypted-ingest
+    # work moved the record to 7.36x with decrypt ahead)
+    assert "= 7.36x" in out
+    assert "dominant stage: decrypt" in out
 
 
 def test_cli_gap_serve_record_and_json(capsys):

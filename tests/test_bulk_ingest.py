@@ -392,3 +392,77 @@ def test_bulk_stream_path_matches_per_file(monkeypatch):
         )
 
     run(go())
+
+
+# ---- ISSUE 13 acceptance: streaming ≡ sequential scalar, adapters × backends
+
+
+@pytest.mark.parametrize("backend", ["memory", "fs"])
+@pytest.mark.parametrize("kind", ["orset", "gcounter", "pncounter"])
+def test_streaming_ingest_matches_scalar_adapters_backends(
+    kind, backend, tmp_path, monkeypatch
+):
+    """The striped streaming front end (pipelined fold sessions, unified
+    work queue, bytes-keyed remap, split sparse fold) must produce
+    byte-identical state AND cursors to the sequential per-file scalar
+    path, for ≥3 adapters on BOTH storage backends."""
+    from crdt_enc_tpu.backends import FsStorage
+
+    adapters = {
+        "orset": orset_adapter,
+        "gcounter": gcounter_adapter,
+        "pncounter": pncounter_adapter,
+    }
+    mk_adapter = adapters[kind]
+
+    if backend == "memory":
+        remote = MemoryRemote()
+
+        def make(name):
+            return MemoryStorage(remote)
+    else:
+        remote_dir = tmp_path / "remote"
+
+        def make(name):
+            return FsStorage(str(tmp_path / f"local-{name}"), str(remote_dir))
+
+    def build(core, i):
+        if kind == "orset":
+            if i % 5 == 4:
+                op = core.with_state(lambda s: s.rm_ctx(i % 7))
+                if op.ctx.is_empty():
+                    return None
+                return op
+            return core.with_state(
+                lambda s: s.add_ctx(core.actor_id, i % 7)
+            )
+        if kind == "pncounter" and i % 3 == 2:
+            return core.with_state(lambda s: s.dec(core.actor_id))
+        return core.with_state(lambda s: s.inc(core.actor_id, 1 + i % 3))
+
+    async def go():
+        writer = await Core.open(make_opts(make("w"), mk_adapter()))
+        for i in range(core_mod.BULK_MIN_FILES + 20):
+            op = build(writer, i)
+            if op is not None:
+                await writer.apply_ops([op])
+
+        streaming = await Core.open(make_opts(
+            make("s"), mk_adapter(),
+            accel=TpuAccelerator(min_device_batch=1),
+        ))
+        await streaming.read_remote()
+
+        monkeypatch.setattr(core_mod, "BULK_MIN_FILES", 10**9)
+        scalar = await Core.open(make_opts(make("r"), mk_adapter()))
+        await scalar.read_remote()
+
+        assert streaming.with_state(canonical_bytes) == scalar.with_state(
+            canonical_bytes
+        )
+        assert (
+            streaming.info().next_op_versions.to_obj()
+            == scalar.info().next_op_versions.to_obj()
+        )
+
+    run(go())

@@ -491,3 +491,116 @@ def test_fsck_cli_verify_checkpoint_flag(tmp_path):
     with open(path, "wb") as f:
         f.write(data[:-5])
     assert fsck_cli.main([remote, "--verify-checkpoint", local]) == 1
+
+
+# ---- checkpoint from streaming-fold rows (ISSUE 13: zero dict walk) -------
+
+
+def test_pack_checkpoint_rows_semantically_equal_to_dict_walk():
+    """A fresh streaming fold stashes its surviving rows; packing the
+    checkpoint from them must unpack to a state canonically identical
+    to the dict-walk pack, and the stash must be mut-epoch-guarded."""
+    import secrets
+
+    import numpy as np
+
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.models.orset import AddOp
+    from crdt_enc_tpu.models.vclock import Dot
+    from crdt_enc_tpu.ops import columnar as C
+    from crdt_enc_tpu.ops.columnar import Vocab
+
+    rng = np.random.default_rng(4)
+    R, E, N = 64, 200, 9000  # ≥ CKPT_STASH_MIN_ROWS surviving rows
+    actors = sorted(secrets.token_bytes(16) for _ in range(R))
+    members = Vocab(list(range(E)))
+    replicas = Vocab(actors)
+    counters = np.zeros(R, np.int64)
+    kind = np.zeros(N, np.int8)
+    member = rng.integers(0, E, N).astype(np.int32)
+    actor = rng.integers(0, R, N).astype(np.int32)
+    ctr = np.zeros(N, np.int32)
+    for i in range(N):
+        a = int(actor[i])
+        roll = rng.random()
+        if roll < 0.05:
+            # future-horizon remove: survives the merged clock, so the
+            # DEFERRED table (dm/da/dc) gets real coverage too
+            kind[i] = 1
+            ctr[i] = counters[a] + 3
+        elif roll < 0.18 and counters[a]:
+            kind[i] = 1
+            ctr[i] = counters[a]
+        else:
+            counters[a] += 1
+            ctr[i] = counters[a]
+    state = ORSet()
+    C.orset_fold_sparse_host(
+        state, kind, member, actor, ctr, members, replicas
+    )
+    stash = getattr(state, "_ckpt_rows", None)
+    assert stash is not None and stash[0] == state._mut
+    from_rows = C.orset_unpack_checkpoint(
+        C.orset_pack_checkpoint_rows(*stash[1])
+    )
+    from_dicts = C.orset_unpack_checkpoint(C.orset_pack_checkpoint(state))
+    assert codec.pack(from_rows.to_obj()) == codec.pack(state.to_obj())
+    assert codec.pack(from_rows.to_obj()) == codec.pack(from_dicts.to_obj())
+    # a later mutation invalidates the stash via the epoch guard
+    state.apply(AddOp(0, Dot(actors[0], int(counters[0]) + 1)))
+    assert stash[0] != state._mut
+
+
+def test_streaming_compact_checkpoints_from_rows(storage_factory, monkeypatch):
+    """End-to-end: a core whose ingest ran the fresh streaming fold
+    seals its warm-open checkpoint FROM THE STASHED ROWS (the dict-walk
+    packer is forbidden by the spy), and the warm reopen restores a
+    state byte-identical to a cold refold."""
+    import crdt_enc_tpu.core.core as core_mod
+    from crdt_enc_tpu.ops import columnar as C
+    from crdt_enc_tpu.parallel.accel import TpuAccelerator
+
+    monkeypatch.setattr(C, "CKPT_STASH_MIN_ROWS", 1)
+    # the tiny test shape would pick the dense device fold; the rows
+    # stash rides the sparse host regime (the config-5 streaming shape)
+    monkeypatch.setattr(
+        TpuAccelerator, "_use_sparse", lambda self, E, R, n: True
+    )
+
+    async def go():
+        writer = await Core.open(
+            make_opts(storage_factory("w"), orset_adapter())
+        )
+        for i in range(core_mod.BULK_MIN_FILES + 8):
+            await writer.apply_ops(
+                [writer.with_state(
+                    lambda s: s.add_ctx(writer.actor_id, i % 9)
+                )]
+            )
+        reader = await Core.open(make_opts(
+            storage_factory("r"), orset_adapter(),
+            accelerator=TpuAccelerator(min_device_batch=1),
+        ))
+
+        def forbidden(state):
+            raise AssertionError(
+                "dict-walk checkpoint pack ran despite a fresh rows stash"
+            )
+
+        monkeypatch.setattr(C, "orset_pack_checkpoint", forbidden)
+        await reader.compact()
+        monkeypatch.undo()
+
+        warm = await Core.open(make_opts(
+            storage_factory("r"), orset_adapter(), create=False,
+        ))
+        assert warm.checkpoint_fallback_reason is None
+        cold = await Core.open(make_opts(
+            storage_factory("cold"), orset_adapter(),
+        ))
+        await cold.read_remote()
+        assert warm.with_state(canonical_bytes) == cold.with_state(
+            canonical_bytes
+        )
+
+    run(go())

@@ -249,14 +249,19 @@ def _native_crypto_or_skip():
         pytest.skip(f"native crypto library unavailable: {e}")
 
 
-def test_export_trace_cli_proves_overlap_on_streaming_run(tmp_path, capsys):
+def test_export_trace_cli_proves_overlap_on_streaming_run(
+    tmp_path, capsys, monkeypatch
+):
     """ISSUE 2 acceptance: obs_report export-trace on a recorded
     streaming run (the --e2e-streaming smoke shape: encrypted blobs →
     fold_encrypted_stream) emits valid Chrome-trace JSON whose events
     prove chunk k+1's ingest overlaps chunk k's fold/reduce."""
     _native_crypto_or_skip()
+    import time as _time
+
     from crdt_enc_tpu.models import ORSet
     from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.parallel import session as psession
     from crdt_enc_tpu.tools import obs_report
     from tests.test_streaming_pipeline import _encrypted_orset_workload
 
@@ -266,8 +271,25 @@ def test_export_trace_cli_proves_overlap_on_streaming_run(tmp_path, capsys):
     accel = TpuAccelerator()
     streamed = ORSet()
     trace.enable_events()
+    # two producers force the threaded pipeline (on a 1-core box the
+    # auto-tuned single producer runs INLINE — no lookahead to prove),
+    # and a slowed consumer widens the overlap window so the proof is
+    # deterministic on one core: a PIPELINED run shows chunk k+1's
+    # ingest starting inside the slow reduce k; a serial run would not,
+    # however slow the reduce — same discipline as the seam tests'
+    # injected delays
+    real_reduce = psession.OrsetFoldSession.reduce_chunk
+
+    def slow_reduce(self, decoded):
+        _time.sleep(0.005)
+        return real_reduce(self, decoded)
+
+    monkeypatch.setattr(
+        psession.OrsetFoldSession, "reduce_chunk", slow_reduce
+    )
     ok = accel.fold_encrypted_stream(
         streamed, key, blobs, actors_hint=sorted(actors), n_chunks=6,
+        n_producers=2,
     )
     assert ok
     assert codec.pack(streamed.to_obj()) == codec.pack(host.to_obj())

@@ -412,16 +412,16 @@ def test_streaming_pipeline_seam_on_real_path():
 
 def test_producer_count_resolution(monkeypatch):
     """stream_producer_count: explicit request > env override > the
-    cpu-count auto-tune (one core left for the consumer, capped)."""
-    from crdt_enc_tpu.ops.stream import MAX_AUTO_PRODUCERS
-
+    cpu-count auto-tune (one producer per core, one core reserved for
+    the consumer, floor 1 — the stale cap of 4 is gone: an idle
+    many-core host scales with its cores)."""
     monkeypatch.delenv("CRDT_STREAM_PRODUCERS", raising=False)
     assert K.stream_producer_count(3) == 3
     auto = K.stream_producer_count()
     import os
 
     cpus = os.cpu_count() or 1
-    assert auto == max(1, min(MAX_AUTO_PRODUCERS, cpus - 1))
+    assert auto == max(1, cpus - 1)
     monkeypatch.setenv("CRDT_STREAM_PRODUCERS", "7")
     assert K.stream_producer_count() == 7
     assert K.stream_producer_count(2) == 2  # explicit still wins
@@ -603,26 +603,29 @@ def test_multi_producer_byte_identical_to_single():
 
     from crdt_enc_tpu.ops import stream as stream_mod
 
-    real_pipeline = stream_mod.run_ingest_pipeline
+    real_pipeline = stream_mod.run_striped_ingest_pipeline
 
-    def jittered_pipeline(spans, ingest_fn, reduce_fn, **kw):
-        def slow_ingest(span, k):
-            time.sleep(delays[k % len(delays)])
-            return ingest_fn(span, k)
+    def jittered_pipeline(spans, split_fn, stripe_fn, assemble_fn,
+                          reduce_fn, **kw):
+        def slow_stripe(stripe, k, s):
+            time.sleep(delays[(k + s) % len(delays)])
+            return stripe_fn(stripe, k, s)
 
-        return real_pipeline(spans, slow_ingest, reduce_fn, **kw)
+        return real_pipeline(
+            spans, split_fn, slow_stripe, assemble_fn, reduce_fn, **kw
+        )
 
     results = {}
     for n_producers in (1, 2, 4):
         streamed = ORSet()
-        stream_mod.run_ingest_pipeline = jittered_pipeline
+        stream_mod.run_striped_ingest_pipeline = jittered_pipeline
         try:
             ok = accel.fold_encrypted_stream(
                 streamed, key, blobs, actors_hint=hint, n_chunks=8,
                 n_producers=n_producers,
             )
         finally:
-            stream_mod.run_ingest_pipeline = real_pipeline
+            stream_mod.run_striped_ingest_pipeline = real_pipeline
         assert ok, f"pipeline declined at n_producers={n_producers}"
         results[n_producers] = codec.pack(streamed.to_obj())
     for n_producers, got in results.items():
@@ -805,3 +808,274 @@ def test_sharded_stream_toggle_off_stays_buffered(monkeypatch):
     monkeypatch.setenv("CRDT_SHARDED_STREAM", "0")
     env_off = TpuAccelerator(mesh=mesh)
     assert not env_off.sharded_stream
+
+
+# ------------------------------------------- unified work queue (stripes)
+
+
+def test_striped_order_deterministic_with_random_delays():
+    """Stripes claimed by 1/2/4 producers with randomized stripe delays
+    still reduce in strict chunk order, with each chunk's parts
+    assembled in stripe order."""
+    rng = np.random.default_rng(3)
+    delays = rng.random(40) * 0.004
+
+    for producers in (1, 2, 4):
+        order = []
+
+        def split(span, k):
+            return [(k, s) for s in range(1 + k % 3)]
+
+        def stripe(item, k, s):
+            time.sleep(delays[(k * 3 + s) % len(delays)])
+            assert item == (k, s)
+            return ("part", k, s)
+
+        def assemble(parts, span, k):
+            assert parts == [("part", k, s) for s in range(1 + k % 3)]
+            return ("chunk", k)
+
+        def reduce(item, k):
+            assert item == ("chunk", k)
+            order.append(k)
+
+        K.run_striped_ingest_pipeline(
+            list(range(18)), split, stripe, assemble, reduce,
+            producers=producers, inline=False,
+        )
+        assert order == list(range(18)), (producers, order)
+
+
+def test_striped_giant_stripe_does_not_block_peers():
+    """One slow stripe occupies one worker while a second worker keeps
+    claiming OTHER stripes — the file-granular claim contract (the old
+    chunk-granular pool serialized everything behind the giant)."""
+    started = []
+    release = threading.Event()
+
+    def split(span, k):
+        return [0, 1] if k == 0 else [0]
+
+    def stripe(item, k, s):
+        started.append((k, s))
+        if (k, s) == (0, 0):
+            assert release.wait(10.0)
+        return (k, s)
+
+    def assemble(parts, span, k):
+        return k
+
+    done = []
+
+    def reduce(item, k):
+        done.append(k)
+
+    t = threading.Thread(
+        target=lambda: K.run_striped_ingest_pipeline(
+            list(range(4)), split, stripe, assemble, reduce,
+            producers=2, inline=False,
+        )
+    )
+    t.start()
+    deadline = time.monotonic() + 10.0
+    # the second worker must make progress past the stalled stripe
+    while len(started) < 4 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(started) >= 4, started
+    assert not done  # chunk order: nothing reduces before chunk 0
+    release.set()
+    t.join(10.0)
+    assert done == [0, 1, 2, 3]
+
+
+def test_striped_fault_propagates_and_joins_workers():
+    before = threading.active_count()
+
+    def split(span, k):
+        return [0, 1]
+
+    def stripe(item, k, s):
+        if (k, s) == (2, 1):
+            raise ValueError("boom at (2,1)")
+        return 0
+
+    with pytest.raises(K.PipelineError) as ei:
+        K.run_striped_ingest_pipeline(
+            list(range(8)), split, stripe, lambda p, sp, k: 0,
+            lambda i, k: None, producers=3, inline=False,
+        )
+    assert isinstance(ei.value.__cause__, ValueError)
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
+def test_striped_consumer_error_cancels_pool():
+    before = threading.active_count()
+
+    def reduce(item, k):
+        if k == 1:
+            raise RuntimeError("consumer dies")
+
+    with pytest.raises(RuntimeError):
+        K.run_striped_ingest_pipeline(
+            list(range(30)), lambda sp, k: [0], lambda it, k, s: 0,
+            lambda p, sp, k: 0, reduce, producers=3, inline=False,
+        )
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
+def test_striped_empty_chunks_and_empty_split():
+    """Zero spans is a no-op; a split returning [] still emits the chunk
+    (assemble sees no parts) and order holds."""
+    K.run_striped_ingest_pipeline(
+        [], lambda sp, k: [0], lambda it, k, s: 0, lambda p, sp, k: 0,
+        lambda i, k: None, producers=2, inline=False,
+    )
+    order = []
+    K.run_striped_ingest_pipeline(
+        list(range(5)),
+        lambda sp, k: [] if k % 2 else [0],
+        lambda it, k, s: "p",
+        lambda parts, sp, k: (k, parts),
+        lambda item, k: order.append(item),
+        producers=2, inline=False,
+    )
+    assert order == [(k, ["p"] if k % 2 == 0 else []) for k in range(5)]
+
+
+def test_striped_inline_auto_on_single_core(monkeypatch):
+    """producers==1 on a 1-core host runs the whole pipeline inline —
+    no worker threads — and still byte-identically (order + parts)."""
+    import crdt_enc_tpu.ops.stream as stream_mod
+
+    monkeypatch.setattr(stream_mod.os, "cpu_count", lambda: 1)
+    spawned = []
+    real_thread = threading.Thread
+
+    class SpyThread(real_thread):
+        def __init__(self, *a, **kw):
+            spawned.append(kw.get("name"))
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(stream_mod.threading, "Thread", SpyThread)
+    order = []
+    K.run_striped_ingest_pipeline(
+        list(range(6)), lambda sp, k: [0, 1],
+        lambda it, k, s: (k, s),
+        lambda parts, sp, k: (k, parts),
+        lambda item, k: order.append(item),
+        producers=1,
+    )
+    assert order == [(k, [(k, 0), (k, 1)]) for k in range(6)]
+    assert spawned == []  # inline: not a single worker thread
+    # explicit inline=False still threads even on one core
+    K.run_striped_ingest_pipeline(
+        list(range(2)), lambda sp, k: [0], lambda it, k, s: 0,
+        lambda p, sp, k: 0, lambda i, k: None, producers=1, inline=False,
+    )
+    assert spawned  # the forced path spawned its worker
+
+
+def test_stream_counters_pinned_on_striped_path():
+    """bytes_decrypted on the accel streaming front door equals EXACTLY
+    the byte sum of the encrypted blobs (counted only after a stripe's
+    decrypt succeeds), and the host/buffer regime issues zero h2d — the
+    attribution marginals' inputs stay trustworthy (ISSUE 13 audit)."""
+    _native_crypto_or_skip()
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.parallel import TpuAccelerator
+
+    key, blobs, actors, host = _encrypted_orset_workload(seed=5)
+    accel = TpuAccelerator()
+    trace.reset()
+    state = ORSet()
+    assert accel.fold_encrypted_stream(
+        state, key, blobs, actors_hint=sorted(actors), n_chunks=4,
+    )
+    snap = trace.snapshot()
+    assert snap["counters"].get("bytes_decrypted", 0) == sum(
+        len(b) for b in blobs
+    )
+    # tiny workload stays in the BUFFER regime; its one device hop is
+    # the dense fold's state-plane upload — exactly clock (R·4) +
+    # add/rm planes (2·E·R·4) for this E=12, R=5 shape.  A drift here
+    # means an unaccounted (or double-counted) device hop appeared.
+    assert snap["counters"].get("h2d_bytes", 0) == 5 * 4 + 2 * 12 * 5 * 4
+    assert codec.pack(state.to_obj()) == codec.pack(host.to_obj())
+    # a failed decrypt (wrong key) counts NOTHING
+    trace.reset()
+    from crdt_enc_tpu.backends.xchacha import AeadError
+
+    with pytest.raises(AeadError):
+        accel.fold_encrypted_stream(
+            ORSet(), secrets.token_bytes(32), blobs,
+            actors_hint=sorted(actors), n_chunks=4,
+        )
+    assert trace.snapshot()["counters"].get("bytes_decrypted", 0) == 0
+
+
+def test_session_fresh_fast_init_matches_general_path():
+    """The fresh-state sorted-hint fast init must agree with the general
+    construction (actor table, R, clock0) and fold byte-identically when
+    the hint arrives UNSORTED (general path) vs sorted (fast path)."""
+    _native_crypto_or_skip()
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.parallel.session import OrsetFoldSession
+
+    key, blobs, actors, host = _encrypted_orset_workload(seed=11)
+    accel = TpuAccelerator()
+    fast = OrsetFoldSession(accel, ORSet(), sorted(actors))
+    slow = OrsetFoldSession(accel, ORSet(), list(reversed(sorted(actors))))
+    assert fast.actors_sorted == slow.actors_sorted
+    assert fast.R == slow.R
+    assert (fast._clock0 == slow._clock0).all()
+
+    # non-fresh: a state with a clock must land in _clock0 exactly
+    seeded = ORSet()
+    from crdt_enc_tpu.models.orset import AddOp
+    from crdt_enc_tpu.models.vclock import Dot
+
+    seeded.apply(AddOp(3, Dot(actors[1], 7)))
+    sess = OrsetFoldSession(accel, seeded, sorted(actors))
+    pos = sess.actors_sorted.index(actors[1])
+    assert sess._clock0[pos] == 7
+
+    results = {}
+    for hint in (sorted(actors), list(reversed(sorted(actors)))):
+        state = ORSet()
+        assert accel.fold_encrypted_stream(
+            state, key, blobs, actors_hint=hint, n_chunks=4
+        )
+        results[tuple(hint)] = codec.pack(state.to_obj())
+    assert len(set(results.values())) == 1
+    assert next(iter(results.values())) == codec.pack(host.to_obj())
+
+
+def test_session_member_collision_declines_on_bytes_path():
+    """1 == True as members: the bytes-keyed remap must decline exactly
+    like the legacy object remap (the dense planes cannot represent the
+    collision), and the caller's fallback still folds correctly."""
+    _native_crypto_or_skip()
+    from crdt_enc_tpu.backends.xchacha import encrypt_blob
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.parallel import TpuAccelerator
+
+    key = secrets.token_bytes(32)
+    actor = b"\x01" * 16
+    blobs = [
+        encrypt_blob(key, codec.pack([[0, 1, [actor, 1]]])),
+        encrypt_blob(key, codec.pack([[0, True, [actor, 2]]])),
+    ]
+    accel = TpuAccelerator()
+    state = ORSet()
+    ok = accel.fold_encrypted_stream(
+        state, key, blobs, actors_hint=[actor], n_chunks=1
+    )
+    assert not ok  # declined, state untouched — caller replays per-op
+    assert not state.entries
