@@ -656,6 +656,23 @@ def _timed_host(fn):
     return time.perf_counter() - t0
 
 
+def _mesh_arg():
+    """``--mesh dp=N[,mp=M]`` → ``(dp, mp)`` for the sharded-service
+    arm of the multitenant sweep, else None (single-chip only)."""
+    if "--mesh" not in sys.argv:
+        return None
+    i = sys.argv.index("--mesh")
+    if i + 1 >= len(sys.argv):
+        raise SystemExit("--mesh wants dp=N[,mp=M]")
+    spec = sys.argv[i + 1]
+    from crdt_enc_tpu.parallel.mesh import parse_mesh_spec
+
+    try:
+        return parse_mesh_spec(spec)
+    except ValueError as e:
+        raise SystemExit(f"--mesh: {e} (got {spec!r})")
+
+
 def _tenants_arg(default: int) -> int:
     """``--tenants N`` (the multitenant sweep size), else ``default``."""
     if "--tenants" in sys.argv:
@@ -722,6 +739,17 @@ def e2e_multitenant(smoke: bool):
     BENCH_MT_MEMBERS (64 per tenant), BENCH_MT_OPF (24 ops/file),
     BENCH_MT_TAIL_PCT (10), BENCH_MT_ITERS (3 — best-of passes per
     side, each on fresh fleet copies).
+
+    ``--mesh dp=N[,mp=M]`` adds the SHARDED arm (ISSUE 14): the same
+    fleet through a mesh-backed FoldService — tenant lanes over dp,
+    member planes over mp — byte-compared against both other arms and
+    recorded under its own metric/config with per-arm steady-state
+    compile counts.  On a CPU box the virtual mesh
+    (XLA_FLAGS=--xla_force_host_platform_device_count=8) exercises the
+    exact SPMD programs a pod would run, but all "devices" share the
+    host's cores — the CPU record is a correctness + compile-count
+    witness, not a speedup claim (that awaits TPU hardware, the PR-7
+    caveat verbatim).
     """
     import asyncio
     import copy
@@ -752,6 +780,24 @@ def e2e_multitenant(smoke: bool):
     from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
 
     crdt_enc_tpu.enable_compilation_cache()
+
+    # --mesh dp=N[,mp=M]: a third arm runs the SAME fleet through a
+    # mesh-backed FoldService (tenant lanes over dp, member planes over
+    # mp — parallel/mesh.py), byte-compared against both other arms
+    mesh_shape = _mesh_arg()
+    mesh = None
+    if mesh_shape is not None:
+        dp_m, mp_m = mesh_shape
+        if len(jax.devices()) < dp_m * mp_m:
+            raise SystemExit(
+                f"--mesh dp={dp_m},mp={mp_m} needs {dp_m * mp_m} devices, "
+                f"found {len(jax.devices())}; on a CPU box set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8 (the virtual "
+                "mesh the tier-1 differential tests use)"
+            )
+        from crdt_enc_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh((dp_m, mp_m))
 
     def opts(storage):
         return OpenOptions(
@@ -839,15 +885,23 @@ def e2e_multitenant(smoke: bool):
             c = await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
             await c.compact()
         del warm_fleet
+        if mesh is not None:  # compile the sharded bucket classes too
+            mesh_warm = [
+                await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+                for r in remotes
+            ]
+            await FoldService(mesh_warm, mesh=mesh).run_cycle()
+            del mesh_warm
 
         # ---- best-of-ITERS passes (each on fresh fleet copies, byte
         # equality asserted on EVERY pair — the e2e-streaming protocol:
         # wall minima, with the full sample sets recorded)
-        t_seq = t_serve = float("inf")
-        seq_lat = serve_lat = None
-        obs_seq = obs_serve = None
+        t_seq = t_serve = t_shard = float("inf")
+        seq_lat = serve_lat = shard_lat = None
+        obs_seq = obs_serve = obs_shard = None
         equal = True
         paths: dict = {}
+        shard_paths: dict = {}
         service = None
         for _ in range(ITERS):
             solo_cores = [
@@ -896,6 +950,36 @@ def e2e_multitenant(smoke: bool):
                 service = svc
                 warm_fleet_cores = served_cores
 
+            if mesh is not None:
+                # sharded arm: one mesh-backed cycle on a third fresh
+                # fleet copy, byte-compared against the solo arm (the
+                # record REFUSES on any per-tenant divergence)
+                shard_cores = [
+                    await Core.open(opts(MemoryStorage(copy.deepcopy(r))))
+                    for r in remotes
+                ]
+                svc_m = FoldService(shard_cores, mesh=mesh)
+                trace.reset()
+                t0 = time.perf_counter()
+                results_m = await svc_m.run_cycle()
+                t = time.perf_counter() - t0
+                errors = [
+                    (i, r.error) for i, r in enumerate(results_m) if r.error
+                ]
+                assert not errors, f"sharded tenant errors: {errors[:3]}"
+                equal = equal and all(
+                    a.with_state(canonical_bytes)
+                    == b.with_state(canonical_bytes)
+                    for a, b in zip(solo_cores, shard_cores)
+                )
+                if t < t_shard:
+                    t_shard = t
+                    shard_lat = [r.latency_s for r in results_m]
+                    obs_shard = trace.snapshot()
+                    shard_paths = {}
+                    for r in results_m:
+                        shard_paths[r.path] = shard_paths.get(r.path, 0) + 1
+
         # ---- warm cycle: the TAIL_PCT op tail lands on the best pass's
         # fleet, the service folds it through the warm plane tier
         n_tail_ops = 0
@@ -914,10 +998,12 @@ def e2e_multitenant(smoke: bool):
         return (
             t_seq, t_serve, seq_lat, serve_lat, equal, paths, obs_seq,
             obs_serve, t_warm, n_tail_ops, warm_hits,
+            t_shard, shard_lat, obs_shard, shard_paths,
         )
 
     (t_seq, t_serve, seq_lat, serve_lat, equal, paths, obs_seq, obs_serve,
-     t_warm, n_tail_ops, warm_hits) = asyncio.run(measure())
+     t_warm, n_tail_ops, warm_hits,
+     t_shard, shard_lat, obs_shard, shard_paths) = asyncio.run(measure())
 
     agg_serve = total_ops / t_serve
     agg_seq = total_ops / t_seq
@@ -938,6 +1024,27 @@ def e2e_multitenant(smoke: bool):
         f"warm cycle: {n_tail_ops} tail ops in {t_warm:.2f}s "
         f"({n_tail_ops / t_warm:,.0f} ops/s, warm hits {warm_hits}/{T})"
     )
+    compiles = lambda snap: int(
+        (snap or {}).get("counters", {}).get("jax_compiles", 0)
+    )
+    sharded_rec = None
+    if mesh is not None:
+        agg_shard = total_ops / t_shard
+        log(
+            f"sharded (dp={dp_m},mp={mp_m}): {t_shard:.2f}s "
+            f"({agg_shard:,.0f} ops/s) = {t_serve / t_shard:.2f}x vs "
+            f"single-chip service; paths: {shard_paths}; steady-state "
+            f"compiles seq/service/sharded = {compiles(obs_seq)}/"
+            f"{compiles(obs_serve)}/{compiles(obs_shard)}"
+        )
+        sharded_rec = {
+            "mesh": {"dp": dp_m, "mp": mp_m},
+            "cycle_s": round(t_shard, 4),
+            "agg_ops_per_sec": round(agg_shard, 1),
+            "vs_single_chip": round(t_serve / t_shard, 2),
+            "tenant_latency": _quantiles_ms(shard_lat),
+            "fold_paths": shard_paths,
+        }
     result = {
         "metric": "orset_multitenant_agg_ops_per_sec",
         "config": f"multitenant_{T}t",
@@ -959,7 +1066,22 @@ def e2e_multitenant(smoke: bool):
         },
         "byte_identical": bool(equal),
         "backend": dev.platform,
+        # steady-state XLA compiles in the measured passes (post-warmup
+        # — zero is the bucket quantization contract, mesh included)
+        "compile_counts": {
+            "sequential": compiles(obs_seq),
+            "service": compiles(obs_serve),
+            **({"sharded": compiles(obs_shard)} if mesh is not None else {}),
+        },
     }
+    if sharded_rec is not None:
+        # its own metric/config so the trend gate tracks the sharded
+        # trajectory separately from the single-chip one
+        result["metric"] = "orset_multitenant_sharded_agg_ops_per_sec"
+        result["config"] = f"multitenant_{T}t_mesh{dp_m}x{mp_m}"
+        result["value"] = sharded_rec["agg_ops_per_sec"]
+        result["sharded"] = sharded_rec
+        result["single_chip_agg_ops_per_sec"] = round(agg_serve, 1)
     print(json.dumps(result))
     if not equal:
         log("FAILED: per-tenant states diverged — refusing to record")
@@ -983,6 +1105,7 @@ def e2e_multitenant(smoke: bool):
                   "total_ops": total_ops, "iters": ITERS},
         "obs": obs_serve,
         "obs_sequential": obs_seq,
+        **({"obs_sharded": obs_shard} if mesh is not None else {}),
     })
 
 

@@ -180,6 +180,13 @@ def _bind(lib) -> None:
         ctypes.c_int,
     ]
     lib.encbox_decrypt_scatter_mt.restype = ctypes.c_int
+    # scalar one-shot MAC + the lane-parallel AEAD tag batch (zero AAD):
+    # the differential tests pin the vectorized verify pass against both
+    # the scalar core and the pure-Python oracle
+    lib.poly1305_mac.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
+    lib.poly1305_mac.restype = None
+    lib.poly1305_aead_tags.argtypes = [u8p, u8p, u64p, ctypes.c_uint64, u8p]
+    lib.poly1305_aead_tags.restype = None
 
     lib.orset_count_rows.argtypes = [u8p, ctypes.c_uint64]
     lib.orset_count_rows.restype = ctypes.c_int64

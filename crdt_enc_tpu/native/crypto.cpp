@@ -15,6 +15,9 @@
 
 #include <cstdint>
 #include <cstring>
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#endif
 #include <array>
 #include <thread>
 #include <vector>
@@ -702,6 +705,38 @@ int crdt_simd_lanes(void) { return SIMD_LANES; }
 
 }  // extern "C"
 
+// Forward declaration: the lane-parallel MAC batch lives with the
+// batched engine below; this thin FFI wrapper is exported above it.
+namespace {
+static void poly1305_aead_tags_batch(const uint8_t* const* otks,
+                                     const uint8_t* const* msgs,
+                                     const uint64_t* lens,
+                                     uint8_t (*tags)[16], uint64_t n);
+}  // namespace
+
+extern "C" {
+
+// Lane-parallel AEAD tag batch (zero AAD — the op-blob envelope's
+// shape): n one-time keys (32B each, concatenated), n messages
+// concatenated with offsets[n+1], n 16-byte tags out.  Exported so the
+// vectorized MAC is differentially testable against the scalar
+// Poly1305 / the pure-Python oracle in isolation, not only through the
+// full decrypt surface.
+void poly1305_aead_tags(const uint8_t* otks, const uint8_t* msgs,
+                        const uint64_t* offsets, uint64_t n, uint8_t* tags) {
+  std::vector<const uint8_t*> kp(n), mp(n);
+  std::vector<uint64_t> lens(n);
+  for (uint64_t i = 0; i < n; i++) {
+    kp[i] = otks + 32 * i;
+    mp[i] = msgs + offsets[i];
+    lens[i] = offsets[i + 1] - offsets[i];
+  }
+  poly1305_aead_tags_batch(kp.data(), mp.data(), lens.data(),
+                           (uint8_t(*)[16])tags, n);
+}
+
+}  // extern "C"
+
 // ---- EncBox envelope fast path --------------------------------------------
 //
 // The wire envelope (backends/xchacha.py, mirroring the reference's EncBox,
@@ -947,6 +982,471 @@ static void chacha20_block_x16(const uint8_t* const keys[16],
   for (int j = 0; j < count; j++) memcpy(outs[j], &x[j], 64);
 }
 
+// ---- lane-parallel Poly1305 ----------------------------------------------
+//
+// The batched verify pass was the engine's last scalar phase: every
+// file's MAC ran the radix-2^44 core one file at a time while the three
+// ChaCha phases ran 4/8/16-wide.  Here the MAC goes lane-parallel the
+// same way — one FILE per 64-bit vector lane, radix-2^26 limbs so every
+// product fits a 64-bit lane (26+26+log2(5·5) ≈ 57 bits worst case).
+// The AEAD construction makes lockstep feasible with no partial-block
+// machinery at all: the Poly input is always data zero-padded to a
+// 16-byte boundary plus one 16-byte length block, i.e. FULL blocks only
+// (hibit always set).  Files of different lengths run lockstep with a
+// per-lane active mask; a finished lane's accumulator is carried
+// through untouched until every lane drains, then each lane finalizes
+// scalar (carry/mod-p/pad — a handful of ops per file).
+//
+// Lane width is half the u32 ChaCha width (64-bit lanes in the same
+// registers): 8 on AVX-512, 4 on AVX2, 2 on the SSE2/NEON baseline.
+
+typedef uint64_t v8q __attribute__((vector_size(64)));
+typedef uint64_t v4q __attribute__((vector_size(32)));
+typedef uint64_t v2q __attribute__((vector_size(16)));
+
+// 32×32→64 widening multiply per 64-bit lane (every Poly1305 operand is
+// < 2^28.4).  GCC does not pattern-match a masked 64-bit vector multiply
+// into the 1-µop widening form, and the general vpmullq it emits instead
+// is microcoded (3 µops, ~5× the latency) — so on x86 the intrinsic is
+// named explicitly; elsewhere the plain lane multiply is already the
+// target's native form.  The generic template is the fallback for lane
+// shapes wider than the build ISA (never dispatched at runtime there).
+template <typename VQ>
+static inline VQ mul32(VQ a, VQ b) {
+  return a * b;
+}
+#if defined(__x86_64__) || defined(__i386__)
+static inline v2q mul32(v2q a, v2q b) {
+  return (v2q)_mm_mul_epu32((__m128i)a, (__m128i)b);
+}
+#if defined(__AVX2__)
+static inline v4q mul32(v4q a, v4q b) {
+  return (v4q)_mm256_mul_epu32((__m256i)a, (__m256i)b);
+}
+#endif
+#if defined(__AVX512F__)
+static inline v8q mul32(v8q a, v8q b) {
+  return (v8q)_mm512_mul_epu32((__m512i)a, (__m512i)b);
+}
+#endif
+#endif
+
+template <typename VQ, int L>
+static void poly1305_aead_tags_xN(const uint8_t* const* otks,
+                                  const uint8_t* const* msgs,
+                                  const uint64_t* lens, uint8_t (*tags)[16],
+                                  int count) {
+  const uint64_t M26 = 0x3ffffff;
+  VQ r0{}, r1{}, r2{}, r3{}, r4{};
+  VQ h0{}, h1{}, h2{}, h3{}, h4{};
+  // clone lanes (a final partial chunk) mirror lane 0 end to end: they
+  // compute lane 0's tag into registers nobody reads, which keeps every
+  // lane permanently active — no masking for short batches, no
+  // out-of-bounds reads
+  const uint8_t* msg_of[L];
+  uint64_t len_of[L], nblocks[L];
+  uint64_t maxb = 0, min_full = UINT64_MAX, min_nb = UINT64_MAX;
+  for (int j = 0; j < L; j++) {
+    int ix = j < count ? j : 0;
+    const uint8_t* k = otks[ix];
+    uint64_t t0 = Poly1305::load64(k), t1 = Poly1305::load64(k + 8);
+    t0 &= 0x0ffffffc0fffffffULL;  // clamp per spec
+    t1 &= 0x0ffffffc0ffffffcULL;
+    r0[j] = t0 & M26;
+    r1[j] = (t0 >> 26) & M26;
+    r2[j] = ((t0 >> 52) | (t1 << 12)) & M26;
+    r3[j] = (t1 >> 14) & M26;
+    r4[j] = t1 >> 40;
+    msg_of[j] = msgs[ix];
+    len_of[j] = lens[ix];
+    // blocks = ceil(data/16) data blocks (last zero-padded) + the
+    // 16-byte length block
+    nblocks[j] = len_of[j] / 16 + (len_of[j] % 16 ? 1 : 0) + 1;
+    if (nblocks[j] > maxb) maxb = nblocks[j];
+    if (nblocks[j] < min_nb) min_nb = nblocks[j];
+    if (len_of[j] / 16 < min_full) min_full = len_of[j] / 16;
+  }
+  const VQ s1 = r1 * 5, s2 = r2 * 5, s3 = r3 * 5, s4 = r4 * 5;
+  const VQ M26v = M26 - (VQ){};
+  const VQ HIBIT = (1ULL << 24) - (VQ){};
+
+  // r² limbs for the two-block interleave (h' = (h+m₁)·r² + m₂·r —
+  // the scalar core's trick: one carry chain per 32 bytes), computed
+  // scalar per lane at init: 26-bit limb products fit u64 with room
+  // for the 5-term sums
+  VQ q0{}, q1{}, q2{}, q3{}, q4{};
+  for (int j = 0; j < L; j++) {
+    uint64_t a0 = r0[j], a1 = r1[j], a2 = r2[j], a3 = r3[j], a4 = r4[j];
+    uint64_t b1 = a1 * 5, b2 = a2 * 5, b3 = a3 * 5, b4 = a4 * 5;
+    uint64_t d0 = a0 * a0 + a1 * b4 + a2 * b3 + a3 * b2 + a4 * b1;
+    uint64_t d1 = a0 * a1 + a1 * a0 + a2 * b4 + a3 * b3 + a4 * b2;
+    uint64_t d2 = a0 * a2 + a1 * a1 + a2 * a0 + a3 * b4 + a4 * b3;
+    uint64_t d3 = a0 * a3 + a1 * a2 + a2 * a1 + a3 * a0 + a4 * b4;
+    uint64_t d4 = a0 * a4 + a1 * a3 + a2 * a2 + a3 * a1 + a4 * a0;
+    uint64_t c;
+    c = d0 >> 26; d0 &= M26; d1 += c;
+    c = d1 >> 26; d1 &= M26; d2 += c;
+    c = d2 >> 26; d2 &= M26; d3 += c;
+    c = d3 >> 26; d3 &= M26; d4 += c;
+    c = d4 >> 26; d4 &= M26; d0 += c * 5;
+    c = d0 >> 26; d0 &= M26; d1 += c;
+    q0[j] = d0; q1[j] = d1; q2[j] = d2; q3[j] = d3; q4[j] = d4;
+  }
+  const VQ t1 = q1 * 5, t2 = q2 * 5, t3 = q3 * 5, t4 = q4 * 5;
+
+  // one block across all lanes: limb split, multiply, reduce — all in
+  // vector registers; only the 2 per-lane 8-byte loads are scalar
+  uint64_t w0[L], w1[L];
+  auto step = [&](VQ active, bool masked) {
+    VQ t0v, t1v;
+    memcpy(&t0v, w0, sizeof t0v);
+    memcpy(&t1v, w1, sizeof t1v);
+    VQ m0 = t0v & M26v;
+    VQ m1 = (t0v >> 26) & M26v;
+    VQ m2 = ((t0v >> 52) | (t1v << 12)) & M26v;
+    VQ m3 = (t1v >> 14) & M26v;
+    VQ m4 = (t1v >> 40) | HIBIT;  // hibit: every AEAD block is full
+    // h' = (h + m)·r mod p; operands ≤ 2^27, products ≤ 2^53, 5-term
+    // sums ≤ 2^55.4 — no 128-bit arithmetic needed in the lanes
+    VQ x0 = h0 + m0, x1 = h1 + m1, x2 = h2 + m2, x3 = h3 + m3, x4 = h4 + m4;
+    VQ d0 = mul32(x0, r0) + mul32(x1, s4) + mul32(x2, s3) + mul32(x3, s2) +
+            mul32(x4, s1);
+    VQ d1 = mul32(x0, r1) + mul32(x1, r0) + mul32(x2, s4) + mul32(x3, s3) +
+            mul32(x4, s2);
+    VQ d2 = mul32(x0, r2) + mul32(x1, r1) + mul32(x2, r0) + mul32(x3, s4) +
+            mul32(x4, s3);
+    VQ d3 = mul32(x0, r3) + mul32(x1, r2) + mul32(x2, r1) + mul32(x3, r0) +
+            mul32(x4, s4);
+    VQ d4 = mul32(x0, r4) + mul32(x1, r3) + mul32(x2, r2) + mul32(x3, r1) +
+            mul32(x4, r0);
+    VQ c;
+    c = d0 >> 26; d0 &= M26v; d1 += c;
+    c = d1 >> 26; d1 &= M26v; d2 += c;
+    c = d2 >> 26; d2 &= M26v; d3 += c;
+    c = d3 >> 26; d3 &= M26v; d4 += c;
+    c = d4 >> 26; d4 &= M26v; d0 += c * 5;
+    c = d0 >> 26; d0 &= M26v; d1 += c;
+    if (masked) {  // a drained lane's h carries through untouched
+      h0 = (d0 & active) | (h0 & ~active);
+      h1 = (d1 & active) | (h1 & ~active);
+      h2 = (d2 & active) | (h2 & ~active);
+      h3 = (d3 & active) | (h3 & ~active);
+      h4 = (d4 & active) | (h4 & ~active);
+    } else {
+      h0 = d0; h1 = d1; h2 = d2; h3 = d3; h4 = d4;
+    }
+  };
+
+  // lockstep region: every lane is a plain full data block — straight
+  // loads, no branches, no mask (the whole batch for equal-size files).
+  // Pairs of blocks run the r² interleave: one carry chain per 32 bytes.
+  uint64_t b = 0;
+  uint64_t u0[L], u1[L];
+  for (; b + 2 <= min_full; b += 2) {
+    for (int j = 0; j < L; j++) {
+      const uint8_t* p = msg_of[j] + b * 16;
+      w0[j] = Poly1305::load64(p);
+      w1[j] = Poly1305::load64(p + 8);
+      u0[j] = Poly1305::load64(p + 16);
+      u1[j] = Poly1305::load64(p + 24);
+    }
+    VQ a0v, a1v, b0v, b1v;
+    memcpy(&a0v, w0, sizeof a0v);
+    memcpy(&a1v, w1, sizeof a1v);
+    memcpy(&b0v, u0, sizeof b0v);
+    memcpy(&b1v, u1, sizeof b1v);
+    VQ x0 = h0 + (a0v & M26v);
+    VQ x1 = h1 + ((a0v >> 26) & M26v);
+    VQ x2 = h2 + (((a0v >> 52) | (a1v << 12)) & M26v);
+    VQ x3 = h3 + ((a1v >> 14) & M26v);
+    VQ x4 = h4 + ((a1v >> 40) | HIBIT);
+    VQ y0 = b0v & M26v;
+    VQ y1 = (b0v >> 26) & M26v;
+    VQ y2 = ((b0v >> 52) | (b1v << 12)) & M26v;
+    VQ y3 = (b1v >> 14) & M26v;
+    VQ y4 = (b1v >> 40) | HIBIT;
+    // 10-term sums of ≤2^53 products stay under 2^57 — still lane-safe
+    VQ d0 = mul32(x0, q0) + mul32(x1, t4) + mul32(x2, t3) + mul32(x3, t2) +
+            mul32(x4, t1) + mul32(y0, r0) + mul32(y1, s4) + mul32(y2, s3) +
+            mul32(y3, s2) + mul32(y4, s1);
+    VQ d1 = mul32(x0, q1) + mul32(x1, q0) + mul32(x2, t4) + mul32(x3, t3) +
+            mul32(x4, t2) + mul32(y0, r1) + mul32(y1, r0) + mul32(y2, s4) +
+            mul32(y3, s3) + mul32(y4, s2);
+    VQ d2 = mul32(x0, q2) + mul32(x1, q1) + mul32(x2, q0) + mul32(x3, t4) +
+            mul32(x4, t3) + mul32(y0, r2) + mul32(y1, r1) + mul32(y2, r0) +
+            mul32(y3, s4) + mul32(y4, s3);
+    VQ d3 = mul32(x0, q3) + mul32(x1, q2) + mul32(x2, q1) + mul32(x3, q0) +
+            mul32(x4, t4) + mul32(y0, r3) + mul32(y1, r2) + mul32(y2, r1) +
+            mul32(y3, r0) + mul32(y4, s4);
+    VQ d4 = mul32(x0, q4) + mul32(x1, q3) + mul32(x2, q2) + mul32(x3, q1) +
+            mul32(x4, q0) + mul32(y0, r4) + mul32(y1, r3) + mul32(y2, r2) +
+            mul32(y3, r1) + mul32(y4, r0);
+    VQ c;
+    c = d0 >> 26; d0 &= M26v; d1 += c;
+    c = d1 >> 26; d1 &= M26v; d2 += c;
+    c = d2 >> 26; d2 &= M26v; d3 += c;
+    c = d3 >> 26; d3 &= M26v; d4 += c;
+    c = d4 >> 26; d4 &= M26v; d0 += c * 5;
+    c = d0 >> 26; d0 &= M26v; d1 += c;
+    h0 = d0; h1 = d1; h2 = d2; h3 = d3; h4 = d4;
+  }
+  for (; b < min_full; b++) {
+    for (int j = 0; j < L; j++) {
+      const uint8_t* p = msg_of[j] + b * 16;
+      w0[j] = Poly1305::load64(p);
+      w1[j] = Poly1305::load64(p + 8);
+    }
+    step(VQ{}, false);
+  }
+  // ragged tail: per-lane pad/lens-block assembly + drain masking
+  for (; b < maxb; b++) {
+    VQ active{};
+    for (int j = 0; j < L; j++) {
+      if (b >= nblocks[j]) { w0[j] = w1[j] = 0; continue; }
+      active[j] = ~0ULL;
+      uint64_t dlen = len_of[j];
+      uint64_t full = dlen / 16;
+      if (b + 1 == nblocks[j]) {  // the length block: aad_len(0) ‖ ct_len
+        w0[j] = 0;
+        w1[j] = dlen;
+      } else if (b < full) {
+        const uint8_t* p = msg_of[j] + b * 16;
+        w0[j] = Poly1305::load64(p);
+        w1[j] = Poly1305::load64(p + 8);
+      } else {  // final partial data block, zero-padded by the AEAD
+        uint8_t blk[16] = {0};
+        memcpy(blk, msg_of[j] + full * 16, dlen - full * 16);
+        w0[j] = Poly1305::load64(blk);
+        w1[j] = Poly1305::load64(blk + 8);
+      }
+    }
+    step(active, b >= min_nb);
+  }
+
+  for (int j = 0; j < count; j++) {  // scalar finalize per lane
+    uint64_t a0 = h0[j], a1 = h1[j], a2 = h2[j], a3 = h3[j], a4 = h4[j];
+    uint64_t c;
+    c = a1 >> 26; a1 &= M26; a2 += c;
+    c = a2 >> 26; a2 &= M26; a3 += c;
+    c = a3 >> 26; a3 &= M26; a4 += c;
+    c = a4 >> 26; a4 &= M26; a0 += c * 5;
+    c = a0 >> 26; a0 &= M26; a1 += c;
+    // g = h - p = h + 5 - 2^130; select g when h >= p (no borrow out)
+    uint64_t g0 = a0 + 5;
+    c = g0 >> 26; g0 &= M26;
+    uint64_t g1 = a1 + c;
+    c = g1 >> 26; g1 &= M26;
+    uint64_t g2 = a2 + c;
+    c = g2 >> 26; g2 &= M26;
+    uint64_t g3 = a3 + c;
+    c = g3 >> 26; g3 &= M26;
+    uint64_t g4 = a4 + c - (1ULL << 26);
+    uint64_t mask = (g4 >> 63) - 1;  // all-ones iff no borrow (h >= p)
+    a0 = (a0 & ~mask) | (g0 & mask);
+    a1 = (a1 & ~mask) | (g1 & mask);
+    a2 = (a2 & ~mask) | (g2 & mask);
+    a3 = (a3 & ~mask) | (g3 & mask);
+    a4 = (a4 & ~mask) | (g4 & M26 & mask);
+    uint64_t f0 = a0 | (a1 << 26) | (a2 << 52);
+    uint64_t f1 = (a2 >> 12) | (a3 << 14) | (a4 << 40);
+    const uint8_t* k = otks[j];
+    using u128 = unsigned __int128;
+    u128 acc = (u128)f0 + Poly1305::load64(k + 16);
+    store64_le(tags[j], (uint64_t)acc);
+    acc = (u128)f1 + Poly1305::load64(k + 24) + (uint64_t)(acc >> 64);
+    store64_le(tags[j] + 8, (uint64_t)acc);
+  }
+}
+
+#if defined(__AVX512IFMA__)
+// The AVX-512 IFMA shape: radix-2^44 limbs (the scalar core's radix)
+// with vpmadd52lo/hi doing the 44×48-bit products directly — 18 madds
+// per 16-byte block across 8 files (2.25/file) vs the scalar core's 9
+// mulx per file.  Product high halves land at 2^52, i.e. 2^8·2^44, so
+// every hi lane is pure carry after an 8-bit shift — no 128-bit
+// arithmetic anywhere.
+static void poly1305_aead_tags_ifma8(const uint8_t* const* otks,
+                                     const uint8_t* const* msgs,
+                                     const uint64_t* lens, uint8_t (*tags)[16],
+                                     int count) {
+  const uint64_t M44 = 0xfffffffffffULL, M42 = 0x3ffffffffffULL;
+  typedef v8q VQ;
+  VQ r0{}, r1{}, r2{};
+  VQ h0{}, h1{}, h2{};
+  const uint8_t* msg_of[8];
+  uint64_t len_of[8], nblocks[8];
+  uint64_t maxb = 0, min_full = UINT64_MAX, min_nb = UINT64_MAX;
+  for (int j = 0; j < 8; j++) {
+    int ix = j < count ? j : 0;  // clone lanes mirror lane 0 (see xN)
+    const uint8_t* k = otks[ix];
+    uint64_t t0 = Poly1305::load64(k), t1 = Poly1305::load64(k + 8);
+    t0 &= 0x0ffffffc0fffffffULL;
+    t1 &= 0x0ffffffc0ffffffcULL;
+    r0[j] = t0 & M44;
+    r1[j] = ((t0 >> 44) | (t1 << 20)) & M44;
+    r2[j] = t1 >> 24;
+    msg_of[j] = msgs[ix];
+    len_of[j] = lens[ix];
+    nblocks[j] = len_of[j] / 16 + (len_of[j] % 16 ? 1 : 0) + 1;
+    if (nblocks[j] > maxb) maxb = nblocks[j];
+    if (nblocks[j] < min_nb) min_nb = nblocks[j];
+    if (len_of[j] / 16 < min_full) min_full = len_of[j] / 16;
+  }
+  const VQ s1 = r1 * 20, s2 = r2 * 20;  // < 2^48.4: valid madd52 operands
+  const VQ M44v = M44 - (VQ){}, M42v = M42 - (VQ){};
+  const VQ HIB = (1ULL << 40) - (VQ){};
+
+  auto madlo = [](VQ acc, VQ a, VQ b) {
+    return (VQ)_mm512_madd52lo_epu64((__m512i)acc, (__m512i)a, (__m512i)b);
+  };
+  auto madhi = [](VQ acc, VQ a, VQ b) {
+    return (VQ)_mm512_madd52hi_epu64((__m512i)acc, (__m512i)a, (__m512i)b);
+  };
+
+  uint64_t w0[8], w1[8];
+  auto step = [&](VQ active, bool masked) {
+    VQ t0v, t1v;
+    memcpy(&t0v, w0, sizeof t0v);
+    memcpy(&t1v, w1, sizeof t1v);
+    VQ x0 = h0 + (t0v & M44v);
+    VQ x1 = h1 + (((t0v >> 44) | (t1v << 20)) & M44v);
+    VQ x2 = h2 + (((t1v >> 24) & M42v) | HIB);  // hibit: blocks all full
+    VQ lo0{}, hi0{}, lo1{}, hi1{}, lo2{}, hi2{};
+    lo0 = madlo(lo0, x0, r0); hi0 = madhi(hi0, x0, r0);
+    lo0 = madlo(lo0, x1, s2); hi0 = madhi(hi0, x1, s2);
+    lo0 = madlo(lo0, x2, s1); hi0 = madhi(hi0, x2, s1);
+    lo1 = madlo(lo1, x0, r1); hi1 = madhi(hi1, x0, r1);
+    lo1 = madlo(lo1, x1, r0); hi1 = madhi(hi1, x1, r0);
+    lo1 = madlo(lo1, x2, s2); hi1 = madhi(hi1, x2, s2);
+    lo2 = madlo(lo2, x0, r2); hi2 = madhi(hi2, x0, r2);
+    lo2 = madlo(lo2, x1, r1); hi2 = madhi(hi2, x1, r1);
+    lo2 = madlo(lo2, x2, r0); hi2 = madhi(hi2, x2, r0);
+    VQ c;
+    c = (lo0 >> 44) + (hi0 << 8);
+    lo0 &= M44v; lo1 += c;
+    c = (lo1 >> 44) + (hi1 << 8);
+    lo1 &= M44v; lo2 += c;
+    c = (lo2 >> 42) + (hi2 << 10);
+    lo2 &= M42v; lo0 += c * 5;
+    c = lo0 >> 44; lo0 &= M44v; lo1 += c;
+    if (masked) {
+      h0 = (lo0 & active) | (h0 & ~active);
+      h1 = (lo1 & active) | (h1 & ~active);
+      h2 = (lo2 & active) | (h2 & ~active);
+    } else {
+      h0 = lo0; h1 = lo1; h2 = lo2;
+    }
+  };
+
+  uint64_t b = 0;
+  for (; b < min_full; b++) {  // lockstep: plain full data blocks
+    for (int j = 0; j < 8; j++) {
+      const uint8_t* p = msg_of[j] + b * 16;
+      w0[j] = Poly1305::load64(p);
+      w1[j] = Poly1305::load64(p + 8);
+    }
+    step(VQ{}, false);
+  }
+  for (; b < maxb; b++) {  // ragged tail: pad/lens blocks + drain mask
+    VQ active{};
+    for (int j = 0; j < 8; j++) {
+      if (b >= nblocks[j]) { w0[j] = w1[j] = 0; continue; }
+      active[j] = ~0ULL;
+      uint64_t dlen = len_of[j];
+      uint64_t full = dlen / 16;
+      if (b + 1 == nblocks[j]) {
+        w0[j] = 0;
+        w1[j] = dlen;
+      } else if (b < full) {
+        const uint8_t* p = msg_of[j] + b * 16;
+        w0[j] = Poly1305::load64(p);
+        w1[j] = Poly1305::load64(p + 8);
+      } else {
+        uint8_t blk[16] = {0};
+        memcpy(blk, msg_of[j] + full * 16, dlen - full * 16);
+        w0[j] = Poly1305::load64(blk);
+        w1[j] = Poly1305::load64(blk + 8);
+      }
+    }
+    step(active, b >= min_nb);
+  }
+
+  for (int j = 0; j < count; j++) {  // scalar finalize (Poly1305::finish)
+    uint64_t a0 = h0[j], a1 = h1[j], a2 = h2[j];
+    uint64_t c;
+    c = a1 >> 44; a1 &= M44; a2 += c;
+    c = a2 >> 42; a2 &= M42; a0 += c * 5;
+    c = a0 >> 44; a0 &= M44; a1 += c;
+    c = a1 >> 44; a1 &= M44; a2 += c;
+    c = a2 >> 42; a2 &= M42; a0 += c * 5;
+    c = a0 >> 44; a0 &= M44; a1 += c;
+    uint64_t g0 = a0 + 5;
+    c = g0 >> 44; g0 &= M44;
+    uint64_t g1 = a1 + c;
+    c = g1 >> 44; g1 &= M44;
+    uint64_t g2 = a2 + c - (1ULL << 42);
+    uint64_t mask = (g2 >> 63) - 1;
+    a0 = (a0 & ~mask) | (g0 & mask);
+    a1 = (a1 & ~mask) | (g1 & mask);
+    a2 = (a2 & ~mask) | (g2 & M42 & mask);
+    uint64_t f0 = a0 | (a1 << 44);
+    uint64_t f1 = (a1 >> 20) | (a2 << 24);
+    const uint8_t* k = otks[j];
+    using u128 = unsigned __int128;
+    u128 acc = (u128)f0 + Poly1305::load64(k + 16);
+    store64_le(tags[j], (uint64_t)acc);
+    acc = (u128)f1 + Poly1305::load64(k + 24) + (uint64_t)(acc >> 64);
+    store64_le(tags[j] + 8, (uint64_t)acc);
+  }
+}
+
+// the .so may have been built on an IFMA box and copied — same
+// degrade-don't-fault contract as simd_lanes_detect()
+static bool ifma_detect() {
+#if (defined(__x86_64__) || defined(__i386__)) && defined(__GNUC__)
+  __builtin_cpu_init();
+  return __builtin_cpu_supports("avx512ifma") != 0;
+#else
+  return false;
+#endif
+}
+static const bool HAVE_IFMA = ifma_detect();
+#endif  // __AVX512IFMA__
+
+// Runtime-dispatched batch front door: AEAD tags (zero AAD — the op-blob
+// envelope's shape) for n (one-time key, message, length) triples, in
+// lane-width chunks.  Shared by the engine's verify phase and the
+// poly1305_aead_tags FFI export the differential tests drive.
+static void poly1305_aead_tags_batch(const uint8_t* const* otks,
+                                     const uint8_t* const* msgs,
+                                     const uint64_t* lens,
+                                     uint8_t (*tags)[16], uint64_t n) {
+  uint64_t i = 0;
+#if defined(__AVX512IFMA__)
+  if (HAVE_IFMA && SIMD_LANES >= LANES16) {
+    for (; i + 8 <= n; i += 8)
+      poly1305_aead_tags_ifma8(otks + i, msgs + i, lens + i, tags + i, 8);
+    if (i < n) {
+      poly1305_aead_tags_ifma8(otks + i, msgs + i, lens + i, tags + i,
+                               (int)(n - i));
+      i = n;
+    }
+    return;
+  }
+#endif
+  if (SIMD_LANES >= LANES16) {
+    for (; i + 8 <= n; i += 8)
+      poly1305_aead_tags_xN<v8q, 8>(otks + i, msgs + i, lens + i, tags + i, 8);
+  } else if (SIMD_LANES >= LANES) {
+    for (; i + 4 <= n; i += 4)
+      poly1305_aead_tags_xN<v4q, 4>(otks + i, msgs + i, lens + i, tags + i, 4);
+  }
+  for (; i < n; i += 2) {
+    int c = (int)(n - i < 2 ? n - i : 2);
+    poly1305_aead_tags_xN<v2q, 2>(otks + i, msgs + i, lens + i, tags + i, c);
+  }
+}
+
 // Per-lane-width kernel selection for the batched engine: 16 lanes use
 // the transpose-optimized AVX-512 shapes above, narrower widths the
 // generic templates (scalar lane extraction — 8/4 lanes have too few
@@ -1024,33 +1524,36 @@ static int encbox_decrypt_batched_impl(
     }
     BatchKern<L>::blk(kp, ctr, np, op, c);
   }
-  // phase 3: batched Poly1305 pass — every file's tag verified in one
-  // sweep (radix-2^44 core, two-block interleave) BEFORE any keystream
-  // XOR, matching the scalar path's verify-then-decrypt order: a blob
-  // whose tag fails must never have plaintext written for it
+  // phase 3: lane-parallel Poly1305 pass — every file's tag computed
+  // one-file-per-lane (poly1305_aead_tags_batch) and verified BEFORE
+  // any keystream XOR, matching the scalar path's verify-then-decrypt
+  // order: a blob whose tag fails must never have plaintext written
   int failures = 0;
+  std::vector<const uint8_t*> mac_keys(n);
+  std::vector<const uint8_t*> mac_msgs(n);
+  std::vector<uint64_t> mac_lens(n);
+  std::vector<std::array<uint8_t, 16>> mac_tags(n);
+  uint64_t n_mac = 0;
   for (uint64_t i = 0; i < n; i++) {
     if (ct_lens[i] < 16) {
       ok_flags[i] = 0;
       failures++;
       continue;
     }
-    uint64_t data_len = ct_lens[i] - 16;
-    const uint8_t* ct = blob_at(blobs, ct_offs[i]);
-    Poly1305 p;
-    p.init(otk[i].data());
-    static const uint8_t zeros[16] = {0};
-    p.update(ct, data_len);
-    if (data_len % 16) p.update(zeros, 16 - (data_len % 16));
-    uint8_t lens[16];
-    store64_le(lens, 0);
-    store64_le(lens + 8, data_len);
-    p.update(lens, 16);
-    uint8_t tag[16];
-    p.finish(tag);
-    int rc = ct_compare16(tag, ct + data_len);
+    ok_flags[i] = 2;  // marks "tag pending" for the verify sweep below
+    mac_keys[n_mac] = otk[i].data();
+    mac_msgs[n_mac] = blob_at(blobs, ct_offs[i]);
+    mac_lens[n_mac] = ct_lens[i] - 16;
+    n_mac++;
+  }
+  poly1305_aead_tags_batch(mac_keys.data(), mac_msgs.data(), mac_lens.data(),
+                           (uint8_t(*)[16])mac_tags.data()->data(), n_mac);
+  for (uint64_t i = 0, q = 0; i < n; i++) {
+    if (ok_flags[i] != 2) continue;
+    int rc = ct_compare16(mac_tags[q].data(), mac_msgs[q] + mac_lens[q]);
     ok_flags[i] = rc == 0 ? 1 : 0;
     if (rc != 0) failures++;
+    q++;
   }
   // phase 4: data keystream jobs (file, block counter) for VERIFIED
   // files only, 16 at a time, XORed into the scattered output positions

@@ -61,7 +61,7 @@ _SERVE_STAGES: dict[str, tuple[tuple[str, ...], ...]] = {
     "decrypt": (("serve.decrypt",),),
     "decode": (("serve.decode",),),
     "h2d": (),
-    "fold": (("serve.fold",),),
+    "fold": (("serve.fold", "serve.shard"),),  # shard = mesh mega-fold
     "scatter": (("serve.scatter",),),
     "seal": (("serve.seal",),),
 }

@@ -44,6 +44,29 @@ else:
         return _experimental_sm(f, **kw)
 
 
+def parse_mesh_spec(spec: str) -> tuple[int, int]:
+    """``"dp=N[,mp=M]"`` → ``(dp, mp)``.  The ONE parser behind every
+    ``--mesh`` CLI flag (bench.py, tools/daemon) — raises ``ValueError``
+    on malformed specs, non-positive axes, or a single-device mesh
+    (``dp·mp < 2``: a size-1 "mesh" silently degrades to the unsharded
+    path, which a flag asking for sharding must never do)."""
+    dp, mp = 1, 1
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        if k == "dp":
+            dp = int(v)
+        elif k == "mp":
+            mp = int(v)
+        else:
+            raise ValueError(f"unknown mesh axis {k!r} (want dp=N[,mp=M])")
+    if dp < 1 or mp < 1 or dp * mp < 2:
+        raise ValueError(
+            f"mesh wants positive axes and at least 2 devices, got "
+            f"dp={dp},mp={mp}"
+        )
+    return dp, mp
+
+
 def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
     """A (dp, mp) mesh over the available devices; defaults to all devices
     on the dp axis."""
@@ -319,6 +342,190 @@ def sharded_stream_fold_step(
     while len(_STREAM_STEP_CACHE) > _STREAM_STEP_CACHE_MAX:
         _STREAM_STEP_CACHE.pop(next(iter(_STREAM_STEP_CACHE)))
     return step
+
+
+# ---- sharded multi-tenant mega-folds --------------------------------------
+#
+# The serving layer's tenant batch (ops/orset.orset_fold_tenants — the
+# vmapped mega-fold) as a MESH axis: tenant lanes partition over ``dp``
+# (each device folds its slice of the fleet, tenants never interact so
+# no cross-dp collective exists at all) and each tenant's member planes
+# partition over ``mp`` (rows replicate across mp and mask to the local
+# member slice — the one cross-device value, the per-tenant clock, is a
+# single ``pmax`` over mp).  One multi-chip pod then serves the
+# many-small-tenants shape the solo ``orset_fold_sharded`` was never
+# built for: a whole bucket of tenants per dispatch, every chip busy.
+
+
+def _tenant_local_fold(clock0, add0, rm0, kind, member, actor, counter,
+                       member_lo, E_local, R):
+    """One tenant's fold against this device's member slice.
+
+    ``add0``/``rm0`` arrive as the tenant's (E_local, R) mp-slice; the
+    tenant's op rows arrive WHOLE (replicated over mp — the tenant lives
+    on one dp shard), so rows outside the slice mask out of the scatter
+    but still feed the clock, exactly as in ``ops.orset.orset_fold``
+    where the clock is the column max over every live add."""
+    pad = actor >= R
+    local_member = member - member_lo
+    in_slice = (local_member >= 0) & (local_member < E_local)
+    is_add = (kind == KIND_ADD) & ~pad
+    is_rm = (kind == KIND_RM) & ~pad & in_slice
+    actor_ix = jnp.minimum(actor, R - 1)
+    member_ix = jnp.clip(local_member, 0, E_local - 1)
+    seg = member_ix * R + actor_ix
+    add_new = jax.ops.segment_max(
+        jnp.where(is_add & in_slice, counter, 0), seg,
+        num_segments=E_local * R,
+    )
+    rm_new = jax.ops.segment_max(
+        jnp.where(is_rm, counter, 0), seg, num_segments=E_local * R
+    )
+    add_new = jnp.maximum(add_new, 0).reshape(E_local, R)
+    rm_new = jnp.maximum(rm_new, 0).reshape(E_local, R)
+    # cell-level stale-add gate (≡ ops.orset.orset_fold's)
+    add_new = jnp.where(add_new > clock0[None, :], add_new, 0)
+    # the clock sees EVERY live add, in-slice or not (each mp shard has
+    # all the tenant's rows) — but gated against clock0 exactly as the
+    # solo kernel's post-gate column max is
+    clock_new = jnp.maximum(
+        jax.ops.segment_max(
+            jnp.where(is_add, counter, 0), actor_ix, num_segments=R
+        ),
+        0,
+    )
+    clock_new = jnp.where(clock_new > clock0, clock_new, 0)
+    # combine the per-shard clocks over mp: each shard computed the full
+    # clock already (rows replicate over mp), so this pmax is a no-op at
+    # mp=1 and pure agreement insurance otherwise
+    clock_new = jax.lax.pmax(clock_new, "mp")
+    clock = jnp.maximum(clock0, clock_new)
+    add = jnp.maximum(add0, add_new)
+    rm = jnp.maximum(rm0, rm_new)
+    add = jnp.where(add > rm, add, 0)
+    rm = jnp.where(rm > clock[None, :], rm, 0)
+    return clock, add, rm
+
+
+def orset_fold_tenants_sharded(
+    mesh: Mesh,
+    clock0,  # (T, R) int32 — per-tenant state clocks
+    add0,  # (T, E, R) int32 — per-tenant state planes
+    rm0,  # (T, E, R) int32
+    kind,  # (T, N) int8 — per-tenant op rows
+    member,  # (T, N) int32
+    actor,  # (T, N) int32  (== num_replicas ⇒ padding row)
+    counter,  # (T, N) int32
+):
+    """Mesh-sharded twin of ``ops.orset.orset_fold_tenants``.
+
+    Layout: the tenant axis shards over ``dp`` (T must divide dp — the
+    serve planner quantizes bucket slots to dp multiples), each tenant's
+    member axis over ``mp`` (E must divide mp — the planner lifts E
+    classes to mp multiples), op rows replicated across mp.  Per-tenant
+    results are byte-identical to the vmapped single-device mega-fold —
+    pinned by the differential tests on the virtual 8-device mesh."""
+    dp = mesh.shape["dp"]
+    mp = mesh.shape["mp"]
+    T, E, R = add0.shape
+    if T % dp or E % mp:
+        raise ValueError(
+            f"pad first: tenants {T} % dp {dp} or members {E} % mp {mp}"
+        )
+    E_local = E // mp
+
+    def body(c0, a0, r0, k, m, ac, ct, lo):
+        def one(c, a, r, kk, mm, aa, cc):
+            return _tenant_local_fold(
+                c, a, r, kk, mm, aa, cc, lo[0], E_local, R
+            )
+
+        return jax.vmap(one)(c0, a0, r0, k, m, ac, ct)
+
+    member_lo = np.arange(mp, dtype=np.int32) * E_local
+    fold = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P("dp", None),
+            P("dp", "mp", None),
+            P("dp", "mp", None),
+            P("dp", None),
+            P("dp", None),
+            P("dp", None),
+            P("dp", None),
+            P("mp"),
+        ),
+        out_specs=(P("dp", None), P("dp", "mp", None), P("dp", "mp", None)),
+        check_vma=False,
+    )
+    return fold(clock0, add0, rm0, kind, member, actor, counter, member_lo)
+
+
+def gcounter_fold_tenants_sharded(
+    mesh: Mesh,
+    clock0,  # (T, R) int32 — per-tenant clocks
+    actor,  # (T, N) int32  (== num_replicas ⇒ padding row)
+    counter,  # (T, N) int32
+):
+    """Mesh-sharded twin of ``ops.counters.gcounter_fold_tenants``:
+    tenant lanes over ``dp``, the tiny (R,) planes shard-local (they
+    replicate over mp — counter tenants are plane-light by definition).
+    T must divide dp."""
+    from ..ops.counters import gcounter_fold
+
+    dp = mesh.shape["dp"]
+    T, R = clock0.shape
+    if T % dp:
+        raise ValueError(f"pad first: tenants {T} % dp {dp}")
+
+    def body(c0, a, ct):
+        def one(c, aa, cc):
+            clock, _value = gcounter_fold(c, aa, cc, num_replicas=R)
+            return clock
+
+        return jax.vmap(one)(c0, a, ct)
+
+    fold = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("dp", None), P("dp", None), P("dp", None)),
+        out_specs=P("dp", None),
+        check_vma=False,
+    )
+    return fold(clock0, actor, counter)
+
+
+# One compiled step pair per mesh, same bounded-LRU discipline (and the
+# same pinning rationale) as _STREAM_STEP_CACHE below: the serve layer
+# calls these per bucket, and shape variation is already quantized by
+# the planner, so jit's own shape cache stays bounded per step.
+_TENANT_STEP_CACHE: dict = {}
+_TENANT_STEP_CACHE_MAX = 8
+
+
+def tenant_fold_steps(mesh: Mesh):
+    """The jitted ``(orset_step, gcounter_step)`` pair for one mesh —
+    shapes are the only statics (derived inside the trace), so a fixed
+    bucket-class set compiles a fixed program set."""
+    steps = _TENANT_STEP_CACHE.pop(mesh, None)
+    if steps is None:
+
+        @jax.jit
+        def orset_step(clock0, add0, rm0, kind, member, actor, counter):
+            return orset_fold_tenants_sharded(
+                mesh, clock0, add0, rm0, kind, member, actor, counter
+            )
+
+        @jax.jit
+        def gcounter_step(clock0, actor, counter):
+            return gcounter_fold_tenants_sharded(mesh, clock0, actor, counter)
+
+        steps = (orset_step, gcounter_step)
+    _TENANT_STEP_CACHE[mesh] = steps  # re-insert = mark most-recently-used
+    while len(_TENANT_STEP_CACHE) > _TENANT_STEP_CACHE_MAX:
+        _TENANT_STEP_CACHE.pop(next(iter(_TENANT_STEP_CACHE)))
+    return steps
 
 
 # ---- counters -------------------------------------------------------------

@@ -14,7 +14,10 @@ planning function:
   one class instead of compiling per size 1..8);
 * tenants of one size class and CRDT kind group into **buckets**; a
   bucket's tenant count pads to a power of two too (floor 1), so the
-  vmapped kernel's leading axis is also drawn from a bounded set;
+  vmapped kernel's leading axis is also drawn from a bounded set — and
+  with an active device mesh the slot classes become dp-multiples and
+  ORSet member classes mp-multiples, so every dispatch divides the mesh
+  axes without adding compile classes (see :func:`plan_buckets`);
 * a tenant too big for batching — rows past ``rows_cap`` or dense
   planes past ``cells_cap`` — **spills to the solo path** (the existing
   single-tenant accelerator fold, which has sparse/streaming regimes for
@@ -86,6 +89,8 @@ def plan_buckets(
     rows_cap: int = DEFAULT_ROWS_CAP,
     cells_cap: int = DEFAULT_CELLS_CAP,
     tenants_cap: int = DEFAULT_TENANTS_CAP,
+    dp: int = 1,
+    mp: int = 1,
 ) -> tuple[list[Bucket], list]:
     """Plan one service cycle's batched dispatches.
 
@@ -93,9 +98,20 @@ def plan_buckets(
     (kind, shape) order, and the keys of tenants that spill to the solo
     path.  Pure — no state, no randomness — so the same shapes always
     produce the same plan.
+
+    ``dp``/``mp`` make the plan mesh-aware (the sharded mega-folds of
+    ``parallel.mesh``): bucket slot counts quantize to **multiples of
+    dp** — the classes become {dp, 2·dp, 4·dp, …}, still a bounded set,
+    so tenant join/evict churn never changes the compiled-shape set and
+    every dispatch's tenant axis divides the mesh — and ORSet member
+    classes lift to **multiples of mp** so each tenant's plane slice
+    divides the model axis.  ``dp=mp=1`` (the default) is exactly the
+    single-chip plan.
     """
     if rows_cap < 1 or cells_cap < 1 or tenants_cap < 1:
         raise ValueError("bucket caps must be positive")
+    if dp < 1 or mp < 1:
+        raise ValueError("mesh axes must be positive")
     groups: dict[tuple, list] = {}
     solo: list = []
     for s in shapes:
@@ -103,6 +119,11 @@ def plan_buckets(
             continue  # nothing to fold — the caller's empty path
         rows_b = _bucket(s.rows)
         e_b = _bucket(s.members) if s.kind == "orset" else 0
+        if e_b and e_b % mp:
+            # lift to the next mp multiple: the class set stays bounded
+            # (a pure function of the power-of-two classes), and a
+            # non-power-of-two mp terminates — doubling would not
+            e_b = -(-e_b // mp) * mp
         r_b = _bucket(s.replicas)
         if s.rows > rows_cap or (s.kind == "orset" and e_b * r_b > cells_cap):
             solo.append(s.key)
@@ -114,10 +135,6 @@ def plan_buckets(
     ):
         for lo in range(0, len(keys), tenants_cap):
             chunk = keys[lo : lo + tenants_cap]
-            buckets.append(
-                Bucket(
-                    kind, rows_b, e_b, r_b, chunk,
-                    _bucket(len(chunk), floor=1),
-                )
-            )
+            slots = dp * _bucket(-(-len(chunk) // dp), floor=1)
+            buckets.append(Bucket(kind, rows_b, e_b, r_b, chunk, slots))
     return buckets, solo

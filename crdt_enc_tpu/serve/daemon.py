@@ -159,10 +159,13 @@ class FleetDaemon:
     seeded simulator schedule replays bit-for-bit."""
 
     def __init__(self, tenants=(), config: DaemonConfig | None = None,
-                 live_port: int | None = None, seed: int = 0):
+                 live_port: int | None = None, seed: int = 0, mesh=None):
         self.config = config if config is not None else DaemonConfig()
+        # mesh passed at construction, straight through to the service:
+        # the daemon's scheduling, backoff, and drain are device-layout
+        # oblivious — only the fold dispatches change shape
         self.service = FoldService(
-            [], self.config.serve, live_port=live_port
+            [], self.config.serve, live_port=live_port, mesh=mesh
         )
         self._entries: dict[str, TenantEntry] = {}
         self._rng = random.Random(f"crdt-daemon-{seed}")

@@ -217,14 +217,43 @@ class FoldService:
     """
 
     def __init__(self, tenants, config: ServeConfig | None = None,
-                 live_port: int | None = None):
+                 live_port: int | None = None, mesh=None):
         self.tenants = list(tenants)
         self.config = config if config is not None else ServeConfig()
+        # device mesh (parallel.mesh.make_mesh): with more than one
+        # device the bucketed mega-folds run the SPMD tenant kernels —
+        # tenant lanes over dp, member planes over mp — and oversize
+        # spills route through a service-owned mesh accelerator's
+        # orset_fold_sharded path instead of the tenant's solo chip.
+        # The planner quantizes bucket classes to the mesh axes, so the
+        # zero-steady-state-recompile contract survives sharding.
+        self.mesh = mesh
+        self._mesh_active = mesh is not None and mesh.size > 1
+        self._mesh_accel = None
+        if self._mesh_active:
+            from ..parallel.accel import TpuAccelerator
+
+            self._mesh_accel = TpuAccelerator(min_device_batch=1, mesh=mesh)
+            trace.gauge("serve_mesh_devices", mesh.size)
         self.warm = (
-            PlaneWarmTier(self.config.warm_bytes)
+            PlaneWarmTier(
+                self.config.warm_bytes,
+                mesh_key=mesh if self._mesh_active else None,
+            )
             if self.config.warm
             else None
         )
+        # the mesh-identity guard, enforced where entries are consumed:
+        # a tier built for another device layout holds plane slices this
+        # service cannot address (today the service builds its own tier,
+        # so this can only fire if tier injection is ever added — which
+        # is exactly when it must)
+        if self.warm is not None and not self.warm.compatible_with(
+            mesh if self._mesh_active else None
+        ):
+            raise ValueError(
+                "warm tier belongs to a different mesh identity"
+            )
         # service-owned live telemetry endpoint (obs/live.py): /metrics,
         # /healthz (per-tenant watermarks + the last cycle summary),
         # /snapshot.  live_port=0 binds an ephemeral port (see
@@ -591,6 +620,8 @@ class FoldService:
                 rows_cap=self.config.rows_cap,
                 cells_cap=self.config.cells_cap,
                 tenants_cap=self.config.tenants_cap,
+                dp=self.mesh.shape["dp"] if self._mesh_active else 1,
+                mp=self.mesh.shape["mp"] if self._mesh_active else 1,
             )
             for key in solo:
                 by_idx[key].result.path = "solo"
@@ -713,12 +744,27 @@ class FoldService:
             )
             + kind.nbytes + member.nbytes + actor.nbytes + counter.nbytes,
         )
-        with trace.span("serve.fold", meta=bi):
-            out = K.orset_fold_tenants(
-                jnp.stack(clock_rows), jnp.stack(add_rows),
-                jnp.stack(rm_rows), kind, member, actor, counter,
-                num_members=E_b, num_replicas=R_b,
-            )
+        if self._mesh_active:
+            # SPMD mega-fold: tenant lanes over dp, member planes over
+            # mp (parallel.mesh.orset_fold_tenants_sharded) — slot and
+            # member classes already divide the mesh by planner law
+            from ..parallel import mesh as pmesh
+
+            orset_step, _ = pmesh.tenant_fold_steps(self.mesh)
+            with trace.span("serve.shard", meta=bi):
+                out = orset_step(
+                    jnp.stack(clock_rows), jnp.stack(add_rows),
+                    jnp.stack(rm_rows), kind, member, actor, counter,
+                )
+            trace.add("serve_sharded_folds", 1)
+            trace.add("serve_sharded_tenants", len(bucket.tenants))
+        else:
+            with trace.span("serve.fold", meta=bi):
+                out = K.orset_fold_tenants(
+                    jnp.stack(clock_rows), jnp.stack(add_rows),
+                    jnp.stack(rm_rows), kind, member, actor, counter,
+                    num_members=E_b, num_replicas=R_b,
+                )
         with trace.span("serve.scatter", meta=bi):
             clock_all = np.asarray(out[0])
             add_all = np.asarray(out[1])
@@ -811,10 +857,19 @@ class FoldService:
         trace.add(
             "h2d_bytes", clock0.nbytes + actor.nbytes + counter.nbytes
         )
-        with trace.span("serve.fold", meta=bi):
-            out = K.gcounter_fold_tenants(
-                clock0, actor, counter, num_replicas=R_b
-            )
+        if self._mesh_active:
+            from ..parallel import mesh as pmesh
+
+            _, gcounter_step = pmesh.tenant_fold_steps(self.mesh)
+            with trace.span("serve.shard", meta=bi):
+                out = gcounter_step(clock0, actor, counter)
+            trace.add("serve_sharded_folds", 1)
+            trace.add("serve_sharded_tenants", len(bucket.tenants))
+        else:
+            with trace.span("serve.fold", meta=bi):
+                out = K.gcounter_fold_tenants(
+                    clock0, actor, counter, num_replicas=R_b
+                )
         with trace.span("serve.scatter", meta=bi):
             out_all = np.asarray(out)
             for slot, key in enumerate(bucket.tenants):
@@ -849,9 +904,22 @@ class FoldService:
             if not w.ok or not w.payloads:
                 continue
             core = w.core
+            # with an active mesh, a columnar oversize spill folds
+            # through the service-owned mesh accelerator — the existing
+            # solo orset_fold_sharded / gcounter_fold_sharded SPMD path
+            # (one huge tenant uses the whole pod) — instead of the
+            # tenant's own single-chip accelerator.  The writeback bumps
+            # the state's _mut epoch, so any planes the tenant's own
+            # accel cached for it expire by token, never go stale.
+            spill_accel = (
+                self._mesh_accel
+                if self._mesh_accel is not None
+                and w.kind in ("orset", "gcounter")
+                else core.accel
+            )
             try:
                 if w.result.path == "solo":
-                    ok = core.accel.fold_payloads(
+                    ok = spill_accel.fold_payloads(
                         core._data.state, list(w.payloads),
                         actors_hint=w.actors_sorted,
                     )

@@ -131,9 +131,11 @@ class SimRunner:
     backend (the shared remote + per-replica local dirs live under it);
     the memory backend ignores it."""
 
-    def __init__(self, schedule: Schedule, *, tmpdir: str | None = None):
+    def __init__(self, schedule: Schedule, *, tmpdir: str | None = None,
+                 mesh=None):
         self.schedule = schedule
         self.tmpdir = tmpdir
+        self.mesh = mesh  # service/daemon cycles run mesh-backed folds
         self.replicas: list[_Replica] = []
         self.members = [
             f"member-{i}".encode() for i in range(schedule.members)
@@ -148,6 +150,13 @@ class SimRunner:
         # its backoff/quarantine state meets the same hostile history
         # the replicas do); created lazily at the first daemon step
         self._daemon = None
+        # ONE FoldService reused across every `service` step (the sim
+        # fast path, ROADMAP item 5): service construction — warm tier,
+        # config, telemetry wiring — was per-step overhead; run_cycle's
+        # tenant-subset override cycles exactly the step's replicas, and
+        # the shared warm tier's identity×epoch guard keeps reuse
+        # byte-exact across the hostile history
+        self._service_pool = None
 
     # ----------------------------------------------------------- plumbing
     def _inner_storage(self, idx: int):
@@ -430,10 +439,13 @@ class SimRunner:
         tenants = [rep]
         if peer is not rep and peer.core is not None:
             tenants.append(peer)
-        service = FoldService(
-            [t.core for t in tenants], ServeConfig(seal_empty=True)
+        if self._service_pool is None:
+            self._service_pool = FoldService(
+                [], ServeConfig(seal_empty=True), mesh=self.mesh
+            )
+        results = await self._service_pool.run_cycle(
+            [t.core for t in tenants]
         )
-        results = await service.run_cycle()
         self.service_cycles += 1
         for t, res in zip(tenants, results):
             if res.error is None:
@@ -482,6 +494,7 @@ class SimRunner:
                     serve=ServeConfig(seal_empty=True),
                 ),
                 seed=self.schedule.seed,
+                mesh=self.mesh,
             )
         daemon = self._daemon
         await self._daemon_sync(daemon)
@@ -569,8 +582,15 @@ class SimRunner:
                         )
             prev = None
             for _ in range(QUIESCE_MAX_ROUNDS):
-                for rep in self.replicas:
-                    await rep.core.read_remote()
+                # batched host-reference reads: the whole fleet's drain
+                # round fans out in one gather instead of N serial
+                # awaits (the sim fast path's second half) — reads are
+                # idempotent merges over a healed, quiet remote, and
+                # each replica's own call stream stays ordered, so the
+                # fixed point and the fault-roll streams are unchanged
+                await asyncio.gather(
+                    *(rep.core.read_remote() for rep in self.replicas)
+                )
                 snap = [
                     (
                         rep.core.with_state(canonical_bytes),

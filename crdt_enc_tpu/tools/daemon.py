@@ -6,7 +6,8 @@
         [--port 9464] [--interval 1.0] [--cycles 0] [--deltas]
 
     python -m crdt_enc_tpu.tools.daemon selftest \\
-        [--tenants 6] [--cycles 6] [--faulty 2] [--seed 0]
+        [--tenants 6] [--cycles 6] [--faulty 2] [--seed 0] \\
+        [--mesh dp=8[,mp=M]]
 
 ``run`` opens one fs-backed :class:`~crdt_enc_tpu.core.Core` per
 ``--tenant LOCAL=REMOTE`` pair (XChaCha data cryptor, plain key wrap —
@@ -24,7 +25,11 @@ cycles — tenant errors must be isolated into backoff/quarantine while
 healthy tenants keep sealing — then the faults heal, the fleet
 recovers, the daemon drains, and every remote must fsck clean AND
 refold (cold) byte-identical to the daemon's live tenant state.  Exit 0
-on a clean pass, 1 on any failed expectation.
+on a clean pass, 1 on any failed expectation.  ``--mesh dp=N[,mp=M]``
+runs the whole smoke through a MESH-backed service (the sharded
+mega-folds of docs/multitenant.md) — on a CPU box export
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` first, the
+virtual mesh the tier-1 differential tests use.
 
 Exit codes: 0 clean, 1 failed expectation / fatal error, 2 usage.
 """
@@ -39,6 +44,36 @@ import signal
 import sys
 
 logger = logging.getLogger("crdt_enc_tpu.tools.daemon")
+
+
+def _parse_mesh(spec: str | None):
+    """``dp=N[,mp=M]`` → a (dp, mp) Mesh, or None when no spec.
+    Exits 2 on malformed specs, degenerate (size < 2) meshes, or too
+    few devices (usage errors) — the shared ``parse_mesh_spec``
+    validation, so ``--mesh dp=1`` can never silently smoke the
+    UNsharded path while claiming mesh coverage."""
+    if not spec:
+        return None
+    from ..parallel.mesh import parse_mesh_spec
+
+    try:
+        dp, mp = parse_mesh_spec(spec)
+    except ValueError as e:
+        print(f"--mesh: {e} (got {spec!r})", file=sys.stderr)
+        raise SystemExit(2)
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    if len(jax.devices()) < dp * mp:
+        print(
+            f"--mesh dp={dp},mp={mp} needs {dp * mp} devices, found "
+            f"{len(jax.devices())}; on a CPU box set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return make_mesh((dp, mp))
 
 
 def _open_opts(storage, *, create: bool, deltas: bool, identity: bool = False):
@@ -172,7 +207,9 @@ async def _selftest(args) -> int:
         quarantine_probe_every=3, backoff_base=1.0, backoff_cap=2.0,
         breaker_after=T + 1, serve=ServeConfig(seal_empty=False),
     )
-    daemon = FleetDaemon(cores, cfg, seed=args.seed)
+    daemon = FleetDaemon(
+        cores, cfg, seed=args.seed, mesh=_parse_mesh(args.mesh)
+    )
     for w in wrappers:
         w.arm()
     flaky.broken = True
@@ -287,6 +324,8 @@ def main(argv=None) -> int:
     p_st.add_argument("--faulty", type=int, default=2,
                       help="tenants wrapped in the all-fault injector")
     p_st.add_argument("--seed", type=int, default=0)
+    p_st.add_argument("--mesh", default=None, metavar="dp=N[,mp=M]",
+                      help="run the smoke through a mesh-backed service")
     p_st.set_defaults(fn=_cmd_selftest)
 
     args = ap.parse_args(argv)

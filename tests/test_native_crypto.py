@@ -452,3 +452,87 @@ def test_simd_lane_dispatch_exported():
     lib.crdt_simd_lanes.argtypes = []
     lib.crdt_simd_lanes.restype = ctypes.c_int
     assert int(lib.crdt_simd_lanes()) in (4, 8, 16)
+
+
+# ---- lane-parallel Poly1305 (the batched verify pass) ----------------------
+# The batch engine's tag phase runs one FILE per vector lane (IFMA
+# radix-2^44 where the CPU has it, portable radix-2^26 lanes otherwise).
+# Beyond the decrypt-surface tests above — which exercise it end to end —
+# these pin the MAC in isolation against the scalar core AND the
+# pure-Python oracle, over exactly the ragged shapes the lockstep+mask
+# machinery has to get right.
+
+
+def _aead_mac_input(data: bytes) -> bytes:
+    """The AEAD construction's Poly input for a zero-AAD message:
+    data zero-padded to 16 bytes ‖ aad_len(0) ‖ ct_len."""
+    pad = data + bytes(-len(data) % 16)
+    return pad + (0).to_bytes(8, "little") + len(data).to_bytes(8, "little")
+
+
+def _lane_tags(otks: list, msgs: list) -> list:
+    import numpy as np
+
+    lib = native.load()
+    n = len(msgs)
+    offsets = np.zeros(n + 1, np.uint64)
+    for i, m in enumerate(msgs):
+        offsets[i + 1] = offsets[i] + len(m)
+    kp, _1 = native.in_ptr(b"".join(otks))
+    mp, _2 = native.in_ptr(b"".join(msgs))
+    tp, tags = native.out_buf(n * 16)
+    lib.poly1305_aead_tags(
+        kp, mp, offsets.ctypes.data_as(native.u64p), n, tp
+    )
+    return [tags[i * 16 : (i + 1) * 16].tobytes() for i in range(n)]
+
+
+def test_poly1305_lane_batch_matches_scalar_and_oracle():
+    """Ragged batches across every lane-fill class (1..17 files) and
+    lengths hitting the lockstep/tail boundary cases: empty, sub-block,
+    exact multiples of 16 (no pad block), and straddles — each lane's
+    tag must equal the scalar core's AND the pure-Python oracle's."""
+    import random
+
+    lib = native.load()
+    rng = random.Random(99)
+    lens_pool = [0, 1, 15, 16, 17, 31, 32, 33, 100, 160, 161, 600, 1024]
+    for n in (1, 2, 3, 5, 7, 8, 9, 15, 16, 17):
+        otks = [secrets.token_bytes(32) for _ in range(n)]
+        msgs = [
+            secrets.token_bytes(rng.choice(lens_pool)) for _ in range(n)
+        ]
+        got = _lane_tags(otks, msgs)
+        for i in range(n):
+            mac_in = _aead_mac_input(msgs[i])
+            assert got[i] == _poly1305_py(otks[i], mac_in), (n, i)
+            kp, _1 = native.in_ptr(otks[i])
+            mp, _2 = native.in_ptr(mac_in)
+            tp, tag = native.out_buf(16)
+            lib.poly1305_mac(kp, mp, len(mac_in), tp)
+            assert got[i] == tag.tobytes(), (n, i)
+
+
+def test_poly1305_lane_batch_equal_lengths_lockstep():
+    """The pure lockstep fast region (all files the same length — the
+    serving batch's common case): byte-exact vs the oracle, including
+    the 16-multiple shape with no pad block at all."""
+    for ln in (48, 64, 600):
+        n = 16
+        otks = [secrets.token_bytes(32) for _ in range(n)]
+        msgs = [secrets.token_bytes(ln) for _ in range(n)]
+        got = _lane_tags(otks, msgs)
+        for i in range(n):
+            assert got[i] == _poly1305_py(otks[i], _aead_mac_input(msgs[i]))
+
+
+def test_poly1305_lane_extreme_length_skew():
+    """One long file among tiny ones: the long lane keeps folding alone
+    while every other lane sits drained under the carry-through mask."""
+    otks = [secrets.token_bytes(32) for _ in range(8)]
+    msgs = [secrets.token_bytes(4096)] + [
+        secrets.token_bytes(i) for i in range(7)
+    ]
+    got = _lane_tags(otks, msgs)
+    for i in range(8):
+        assert got[i] == _poly1305_py(otks[i], _aead_mac_input(msgs[i])), i

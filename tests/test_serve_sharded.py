@@ -1,0 +1,472 @@
+"""Pod-scale serving (ISSUE 14): tenant-batch as a mesh axis.
+
+The contract under test: sharding the multi-tenant mega-fold across the
+device mesh — tenant lanes over ``dp``, member planes over ``mp``
+(``parallel.mesh.orset_fold_tenants_sharded`` and its G-Counter twin) —
+must be an *invisible* layout change.  Byte-identity per tenant to both
+the single-chip FoldService cycle and the solo ``Core.compact()`` path,
+the bucket planner's dp/mp quantization keeping the compiled-shape set
+constant under tenant churn, oversize tenants riding the existing solo
+``orset_fold_sharded`` SPMD path, and the control plane (FleetDaemon)
+running mesh-backed inside PR-9 all-fault schedules — all on the
+virtual 8-device CPU mesh the conftest forces.
+"""
+
+import asyncio
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from crdt_enc_tpu import ops as K
+from crdt_enc_tpu.backends import (
+    FsStorage,
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import (
+    Core,
+    OpenOptions,
+    gcounter_adapter,
+    orset_adapter,
+)
+from crdt_enc_tpu.models import canonical_bytes
+from crdt_enc_tpu.obs import runtime as obs_runtime
+from crdt_enc_tpu.parallel import TpuAccelerator
+from crdt_enc_tpu.parallel import mesh as pmesh
+from crdt_enc_tpu.serve import (
+    FoldService,
+    PlaneWarmTier,
+    ServeConfig,
+    TenantShape,
+    plan_buckets,
+)
+from crdt_enc_tpu.utils import trace
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_opts(storage, adapter=None, create=True, **kw):
+    kw.setdefault("accelerator", TpuAccelerator(min_device_batch=1))
+    return OpenOptions(
+        storage=storage,
+        cryptor=IdentityCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=adapter if adapter is not None else orset_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        create=create,
+        **kw,
+    )
+
+
+async def write_orset(storage, n_ops, tag, rm_every=7):
+    core = await Core.open(make_opts(storage))
+    for i in range(n_ops):
+        m = b"%s-%d" % (tag, i % 31)
+        await core.apply_ops(
+            [core.with_state(lambda s, m=m: s.add_ctx(core.actor_id, m))]
+        )
+        if rm_every and i % rm_every == rm_every - 1:
+            victim = b"%s-%d" % (tag, (i * 3) % 31)
+
+            def rm(s, victim=victim):
+                return s.rm_ctx(victim) if victim in s.entries else None
+
+            op = core.with_state(rm)
+            if op is not None:
+                await core.apply_ops([op])
+    return core
+
+
+async def write_gcounter(storage, n_ops):
+    core = await Core.open(make_opts(storage, gcounter_adapter()))
+    for _ in range(n_ops):
+        await core.apply_ops(
+            [core.with_state(lambda s: s.inc(core.actor_id))]
+        )
+    return core
+
+
+# ------------------------------------------------- kernel differentials
+
+
+@pytest.mark.parametrize("dp,mp", [(8, 1), (4, 2), (2, 4)])
+def test_tenant_fold_sharded_kernel_differential(dp, mp):
+    """The sharded tenant mega-fold is byte-identical to the vmapped
+    single-device kernel on random ragged stacks — including sentinel
+    padding rows, all-sentinel dummy tenant lanes over zero planes, and
+    pre-populated (normalized and not) state planes — across tenant/dp
+    and member/mp splits."""
+    rng = np.random.default_rng(dp * 10 + mp)
+    mesh = pmesh.make_mesh((dp, mp))
+    T, N, R = 16, 48, 4
+    E = max(8, mp * 4)
+    clock0 = rng.integers(0, 5, (T, R)).astype(np.int32)
+    add0 = np.where(
+        rng.random((T, E, R)) < 0.3, rng.integers(1, 9, (T, E, R)), 0
+    ).astype(np.int32)
+    rm0 = np.where(
+        rng.random((T, E, R)) < 0.2, rng.integers(1, 9, (T, E, R)), 0
+    ).astype(np.int32)
+    kind = rng.integers(0, 2, (T, N)).astype(np.int8)
+    member = rng.integers(0, E, (T, N)).astype(np.int32)
+    actor = rng.integers(0, R + 1, (T, N)).astype(np.int32)  # R = pad
+    counter = rng.integers(1, 12, (T, N)).astype(np.int32)
+    for t in (T - 1, T - 2):  # dummy lanes
+        actor[t, :] = R
+        clock0[t] = 0
+        add0[t] = 0
+        rm0[t] = 0
+    ref = K.orset_fold_tenants(
+        clock0, add0, rm0, kind, member, actor, counter,
+        num_members=E, num_replicas=R,
+    )
+    orset_step, gcounter_step = pmesh.tenant_fold_steps(mesh)
+    got = orset_step(clock0, add0, rm0, kind, member, actor, counter)
+    for a, b, name in zip(ref, got, ("clock", "add", "rm")):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+    gc_clock = rng.integers(0, 5, (T, R)).astype(np.int32)
+    ga = rng.integers(0, R + 1, (T, N)).astype(np.int32)
+    gc = rng.integers(1, 99, (T, N)).astype(np.int32)
+    gref = K.gcounter_fold_tenants(gc_clock, ga, gc, num_replicas=R)
+    ggot = gcounter_step(gc_clock, ga, gc)
+    assert np.array_equal(np.asarray(gref), np.asarray(ggot))
+
+
+def test_tenant_fold_sharded_rejects_undivisible():
+    mesh = pmesh.make_mesh((8, 1))
+    z = np.zeros((6, 8, 4), np.int32)  # 6 tenants % dp=8
+    with pytest.raises(ValueError, match="pad first"):
+        pmesh.orset_fold_tenants_sharded(
+            mesh, np.zeros((6, 4), np.int32), z, z,
+            np.zeros((6, 8), np.int8), np.zeros((6, 8), np.int32),
+            np.zeros((6, 8), np.int32), np.zeros((6, 8), np.int32),
+        )
+    with pytest.raises(ValueError, match="pad first"):
+        pmesh.gcounter_fold_tenants_sharded(
+            mesh, np.zeros((6, 4), np.int32),
+            np.zeros((6, 8), np.int32), np.zeros((6, 8), np.int32),
+        )
+
+
+def test_tenant_step_cache_is_bounded_lru():
+    pmesh._TENANT_STEP_CACHE.clear()
+    mesh = pmesh.make_mesh((8, 1))
+    steps = pmesh.tenant_fold_steps(mesh)
+    assert pmesh.tenant_fold_steps(mesh) is steps  # cached per mesh
+    assert len(pmesh._TENANT_STEP_CACHE) == 1
+
+
+# ------------------------------------------------------ planner (dp/mp)
+
+
+def test_plan_buckets_dp_quantizes_slots():
+    """Slot classes become dp-multiples: {dp, 2·dp, 4·dp, …} — bounded
+    AND always divisible by the tenant mesh axis."""
+    shapes = [TenantShape(i, "orset", 40, 10, 4) for i in range(3)]
+    buckets, solo = plan_buckets(shapes, dp=8)
+    assert solo == []
+    assert [b.slots for b in buckets] == [8]  # 3 tenants → 8 lanes
+    shapes = [TenantShape(i, "orset", 40, 10, 4) for i in range(9)]
+    (bucket,), _ = plan_buckets(shapes, dp=8)
+    assert bucket.slots == 16  # 9 tenants → 2·dp
+    # dp=1 is exactly the historical plan
+    (bucket,), _ = plan_buckets(shapes, dp=1)
+    assert bucket.slots == 16  # pow2 floor 1
+    with pytest.raises(ValueError):
+        plan_buckets(shapes, dp=0)
+
+
+def test_plan_buckets_mp_lifts_member_classes():
+    shapes = [TenantShape(0, "orset", 40, 3, 4)]  # E class 8
+    (bucket,), _ = plan_buckets(shapes, mp=16)
+    assert bucket.members == 16  # lifted to divide mp
+    (bucket,), _ = plan_buckets(shapes, mp=2)
+    assert bucket.members == 8  # pow2 already divides
+    # a non-power-of-two mp must terminate and still divide (the
+    # doubling lift looped forever here — review regression)
+    (bucket,), _ = plan_buckets(shapes, mp=3)
+    assert bucket.members % 3 == 0 and bucket.members >= 8
+
+
+def test_parse_mesh_spec_validation():
+    """The ONE --mesh parser (bench + daemon CLI): malformed specs,
+    unknown axes, and degenerate size-1 meshes are ValueErrors — a
+    sharding flag must never silently run the unsharded path."""
+    assert pmesh.parse_mesh_spec("dp=8") == (8, 1)
+    assert pmesh.parse_mesh_spec("dp=4,mp=2") == (4, 2)
+    assert pmesh.parse_mesh_spec("mp=2") == (1, 2)
+    for bad in ("dp=1", "dp=0", "dp=0,mp=5", "dp=eight", "dq=8", ""):
+        with pytest.raises(ValueError):
+            pmesh.parse_mesh_spec(bad)
+
+
+def test_plan_buckets_mesh_churn_shape_invariance():
+    """Join/evict churn across same-class tenants never changes the
+    compiled-shape set on a mesh: any count in (0, dp] shares one slot
+    class, and shuffles of one class mix plan identical shapes."""
+    rng = random.Random(7)
+    base = [TenantShape(i, "orset", 50 + (i % 3), 10, 4) for i in range(20)]
+    shuffled = list(base)
+    rng.shuffle(shuffled)
+    shape_set = lambda bs: sorted(
+        (b.kind, b.rows, b.members, b.replicas, b.slots) for b in bs
+    )
+    a, _ = plan_buckets(base, dp=8, mp=2)
+    b, _ = plan_buckets(shuffled, dp=8, mp=2)
+    assert shape_set(a) == shape_set(b)
+    # shrinking the fleet within one dp-quantum keeps the class
+    c, _ = plan_buckets(base[:17], dp=8, mp=2)
+    assert {x.slots for x in c} <= {x.slots for x in a}
+
+
+# ------------------------------------- service differential (mesh arm)
+
+
+@pytest.fixture(params=["memory", "fs"])
+def fleet_backend(request, tmp_path):
+    """Per-tenant storage factories over either backend; ``split(t)``
+    freezes tenant ``t``'s remote into an independent twin."""
+    if request.param == "memory":
+
+        class B:
+            def __init__(self):
+                self.remotes = {}
+
+            def storage(self, t):
+                r = self.remotes.setdefault(t, MemoryRemote())
+                return MemoryStorage(r)
+
+            def twin_storage(self, t):
+                return MemoryStorage(copy.deepcopy(self.remotes[t]))
+
+        return B()
+
+    class B:
+        def __init__(self):
+            self.n = {}
+
+        def storage(self, t):
+            i = self.n.get(t, 0)
+            self.n[t] = i + 1
+            return FsStorage(
+                str(tmp_path / f"local-{t}-{i}"), str(tmp_path / f"r{t}")
+            )
+
+        def twin_storage(self, t):
+            import shutil
+
+            i = self.n.get(t, 0)
+            self.n[t] = i + 1
+            dst = tmp_path / f"r{t}-twin{i}"
+            shutil.copytree(str(tmp_path / f"r{t}"), str(dst))
+            return FsStorage(str(tmp_path / f"local-t{t}-{i}"), str(dst))
+
+    return B()
+
+
+def test_sharded_mixed_fleet_differential(fleet_backend):
+    """The acceptance differential: a mixed ragged fleet — ragged
+    ORSets, a G-Counter, an oversize spill, an empty tenant — cycled by
+    a mesh-backed FoldService is byte-identical per tenant to BOTH the
+    single-chip service and solo ``Core.compact()``, across memory and
+    fs backends, and the sealed snapshots read back cold."""
+
+    async def scenario():
+        sizes = [0, 23, 57, 110, 40, 200]  # 200 > rows_cap=128 → spill
+
+        async def build():
+            for t, n in enumerate(sizes):
+                if t == 4:
+                    await write_gcounter(fleet_backend.storage(t), sizes[4])
+                elif n:
+                    await write_orset(
+                        fleet_backend.storage(t), n, b"t%d" % t
+                    )
+                else:  # empty tenant: bootstrap the remote (meta only)
+                    await Core.open(make_opts(fleet_backend.storage(t)))
+
+        await build()
+
+        def ad(t):
+            return gcounter_adapter() if t == 4 else orset_adapter()
+
+        solo = [
+            await Core.open(make_opts(fleet_backend.twin_storage(t), ad(t)))
+            for t in range(len(sizes))
+        ]
+        for c in solo:
+            await c.compact()
+
+        chip = [
+            await Core.open(make_opts(fleet_backend.twin_storage(t), ad(t)))
+            for t in range(len(sizes))
+        ]
+        chip_res = await FoldService(
+            chip, ServeConfig(rows_cap=128)
+        ).run_cycle()
+
+        mesh = pmesh.make_mesh((4, 2))
+        served = [
+            await Core.open(make_opts(fleet_backend.storage(t), ad(t)))
+            for t in range(len(sizes))
+        ]
+        trace.reset()
+        results = await FoldService(
+            served, ServeConfig(rows_cap=128), mesh=mesh
+        ).run_cycle()
+        snap = trace.snapshot()["counters"]
+        paths = [r.path for r in results]
+        assert paths[0] == "empty"
+        assert paths[1] == paths[2] == paths[3] == paths[4] == "batched"
+        assert paths[5] == "solo"  # oversize: the SPMD solo spill
+        assert [r.path for r in chip_res] == paths
+        assert snap.get("serve_sharded_folds", 0) >= 2  # orset + gcounter
+        assert snap.get("serve_sharded_tenants", 0) == 4
+        for t, (a, b, c) in enumerate(zip(solo, chip, served)):
+            sb = a.with_state(canonical_bytes)
+            assert sb == c.with_state(canonical_bytes), (
+                f"tenant {t} sharded diverged ({paths[t]})"
+            )
+            assert sb == b.with_state(canonical_bytes), (
+                f"tenant {t} single-chip diverged"
+            )
+        assert all(r.sealed for r in results)
+        # cold readback of the mesh-sealed snapshots
+        for t in range(len(sizes)):
+            cold = await Core.open(
+                make_opts(fleet_backend.twin_storage(t), ad(t))
+            )
+            await cold.read_remote()
+            assert cold.with_state(canonical_bytes) == served[
+                t
+            ].with_state(canonical_bytes), f"tenant {t} cold readback"
+
+    run(scenario())
+
+
+def test_sharded_bounded_compiles_across_shuffled_mixes():
+    """Zero steady-state XLA recompiles across tenant churn on the
+    mesh: two shuffled fleets of one size-class set fold through the
+    same compiled sharded programs (the acceptance gate's compile
+    half)."""
+
+    async def build_fleet(sizes, tag):
+        served = []
+        for t, n in enumerate(sizes):
+            remote = MemoryRemote()
+            await write_orset(
+                MemoryStorage(remote), n, b"%s%d" % (tag, t), rm_every=5
+            )
+            served.append(await Core.open(make_opts(MemoryStorage(remote))))
+        return served
+
+    async def scenario():
+        obs_runtime.track_recompiles()
+        mesh = pmesh.make_mesh((8, 1))
+        sizes = [20, 25, 30, 90, 100, 40]
+        fleet_a = await build_fleet(sizes, b"a")
+        await FoldService(fleet_a, mesh=mesh).run_cycle()  # warmup
+        baseline = obs_runtime.recompile_count()
+        shuffled = list(sizes)
+        random.Random(11).shuffle(shuffled)
+        fleet_b = await build_fleet(shuffled, b"b")
+        await FoldService(fleet_b, mesh=mesh).run_cycle()
+        assert obs_runtime.recompile_count() == baseline, (
+            "a shuffled tenant mix of the same size classes recompiled "
+            "the SHARDED mega-fold"
+        )
+        # ...and fleet-size churn within one dp quantum stays compiled
+        fleet_c = await build_fleet(sizes[:5], b"c")
+        await FoldService(fleet_c, mesh=mesh).run_cycle()
+        assert obs_runtime.recompile_count() == baseline, (
+            "tenant join/evict churn within a dp slot class recompiled"
+        )
+
+    run(scenario())
+
+
+def test_warm_tier_mesh_identity_and_cross_cycle_reuse():
+    """The warm tier is keyed by mesh identity (device-sharded slices
+    are only addressable under their mesh), and cross-cycle warm reuse
+    on the mesh stays byte-identical vs a cold reader."""
+    tier = PlaneWarmTier(mesh_key=None)
+    assert tier.compatible_with(None)
+    mesh = pmesh.make_mesh((8, 1))
+    tier_m = PlaneWarmTier(mesh_key=mesh)
+    assert tier_m.compatible_with(mesh)
+    assert not tier_m.compatible_with(None)
+    assert not tier.compatible_with(mesh)
+
+    async def scenario():
+        remotes = [MemoryRemote() for _ in range(3)]
+        for t, r in enumerate(remotes):
+            await write_orset(MemoryStorage(r), 35, b"w%d" % t)
+        served = [
+            await Core.open(make_opts(MemoryStorage(r))) for r in remotes
+        ]
+        service = FoldService(served, mesh=mesh)
+        assert service.warm.compatible_with(mesh)
+        await service.run_cycle()
+        assert len(service.warm) == 3
+        for t, r in enumerate(remotes):
+            await write_orset(MemoryStorage(r), 12, b"x%d" % t, rm_every=0)
+        trace.reset()
+        results = await service.run_cycle()
+        snap = trace.snapshot()["counters"]
+        assert snap["serve_warm_hits"] == 3
+        assert all(r.path == "batched" for r in results)
+        for c, r in zip(served, remotes):
+            cold = await Core.open(make_opts(MemoryStorage(r)))
+            await cold.read_remote()
+            assert c.with_state(canonical_bytes) == cold.with_state(
+                canonical_bytes
+            )
+
+    run(scenario())
+
+
+# --------------------------------------- control plane on the mesh
+
+
+def test_daemon_mesh_cycles_and_drain_inside_allfault_sim():
+    """FleetDaemon ``run_cycle`` + graceful drain with a MESH-backed
+    service, inside a PR-9 all-fault schedule: the daemon/ddrain
+    vocabulary runs against torn reads, partial listings, delayed
+    visibility and crashes, and all five quiescence invariants hold —
+    the sharded fold path under the same hostility every other path
+    faces."""
+    from crdt_enc_tpu.sim import FaultConfig, SimRunner, generate
+
+    mesh = pmesh.make_mesh((8, 1))
+    schedule = generate(3, 4, 80, FaultConfig.all_faults(), daemon=True)
+    assert any(s.kind == "daemon" for s in schedule.steps)
+    assert any(s.kind == "ddrain" for s in schedule.steps)
+    result = SimRunner(schedule, mesh=mesh).run()
+    assert result.ok, result.violation
+    assert result.daemon_cycles > 0
+
+
+def test_sim_service_pool_reused_across_steps():
+    """The sim fast path: one FoldService instance serves every
+    ``service`` step of a schedule (construction was per-step
+    overhead), and the run still satisfies every invariant."""
+    from crdt_enc_tpu.sim import FaultConfig, SimRunner, generate
+
+    schedule = generate(1, 4, 60, FaultConfig.none())
+    if not any(s.kind == "service" for s in schedule.steps):
+        schedule = generate(5, 4, 120, FaultConfig.none())
+    assert any(s.kind == "service" for s in schedule.steps)
+    runner = SimRunner(schedule)
+    result = runner.run()
+    assert result.ok, result.violation
+    assert result.service_cycles > 0
+    assert runner._service_pool is not None  # built once, reused

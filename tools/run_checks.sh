@@ -49,6 +49,16 @@ echo "== daemon smoke: faulted cycles -> drain -> fsck =="
 JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.daemon selftest \
     --tenants 6 --cycles 6 --faulty 2
 
+echo "== sharded-serve smoke (8 virtual devices) =="
+# the mesh-backed FoldService path on the virtual 8-device CPU mesh
+# (docs/multitenant.md "Sharding the fleet across a pod"): faulted
+# daemon cycles through the sharded mega-folds, drain, fsck, and the
+# cold-refold byte-identity assert — so the mesh path cannot rot on
+# CPU-only boxes
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+    python -m crdt_enc_tpu.tools.daemon selftest \
+    --tenants 6 --cycles 4 --faulty 2 --mesh dp=8
+
 echo "== delta-vs-snapshot differential gate =="
 # chained delta consumers must be byte-identical to full-snapshot
 # consumers across adapters (incl. the composed resettable counter)
