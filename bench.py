@@ -688,20 +688,23 @@ def _tenants_arg(default: int) -> int:
     return default
 
 
+def _nearest_rank(vals: list, frac: float):
+    """THE nearest-rank quantile (ceil(frac·n)-th smallest) — one
+    implementation for every bench family, so p99 can never silently
+    mean different things across records."""
+    import math
+
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, math.ceil(frac * len(s)) - 1))]
+
+
 def _quantiles_ms(samples_s: list) -> dict:
     """Exact nearest-rank p50/p99 of a latency sample set, in ms (the
     obs histograms are ±9% bucketed; the bench records exact values)."""
-    import math
-
-    s = sorted(samples_s)
-
-    def pick(q):
-        return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
-
     return {
-        "p50_ms": round(pick(0.50) * 1e3, 2),
-        "p99_ms": round(pick(0.99) * 1e3, 2),
-        "max_ms": round(s[-1] * 1e3, 2),
+        "p50_ms": round(_nearest_rank(samples_s, 0.50) * 1e3, 2),
+        "p99_ms": round(_nearest_rank(samples_s, 0.99) * 1e3, 2),
+        "max_ms": round(max(samples_s) * 1e3, 2),
     }
 
 
@@ -1396,11 +1399,7 @@ def e2e_daemon(smoke: bool):
 
     rate = total_ops / wall
     # freshness lag is in VERSIONS (not a latency) — exact nearest-rank
-    import math
-
-    def q(vals, frac):
-        s = sorted(vals)
-        return s[min(len(s) - 1, max(0, math.ceil(frac * len(s)) - 1))]
+    q = _nearest_rank
 
     result = {
         "metric": "daemon_e2e_agg_ops_per_sec",
@@ -2030,6 +2029,174 @@ def e2e_delta(smoke: bool):
     })
 
 
+def e2e_strong_read(smoke: bool):
+    """ISSUE-15 acceptance: linearizable point reads at the stability
+    watermark under producer churn (docs/strong_reads.md).
+
+    R producer replicas and one reader share an XChaCha-encrypted
+    remote.  Each round every producer seals a wave of op files and —
+    on a staggered cadence — compacts (publishing its cursor, which is
+    what advances the watermark); the reader interleaves EVENTUAL reads
+    (``read_remote`` + ``Core.read()``) with STRONG reads
+    (``Core.read(linearizable=True)``, which refreshes, recomputes the
+    watermark and advances the stable prefix), sampling the
+    watermark-advance lag (union versions ahead of the served frontier)
+    at every strong read plus an untimed ``max_lag=0`` refusal probe
+    (``refusals`` = how often a zero-staleness caller would have been
+    refused under this churn).  The record is strong reads/s with
+    p50/p99 latency for both tiers and the lag distribution — the
+    price of the guarantee, measured, not asserted.
+
+    Evidence guard: the final strong read (everything published) must
+    be byte-identical to a pure-Python oracle fold of exactly the cut
+    it names — ANY divergence refuses the record.  Protocol-level and
+    CPU-bound by design (the fold tails are host-side), so records land
+    in BENCH_LOCAL.jsonl without the TPU gate, like ``--sim``.
+
+    Env knobs: BENCH_SR_PRODUCERS (4), BENCH_SR_ROUNDS (6),
+    BENCH_SR_WAVE (24 ops/producer/round), BENCH_SR_READS (6
+    strong+eventual pairs/round), BENCH_SR_PUB_EVERY (2 — rounds
+    between a producer's cursor publications).
+    """
+    import asyncio
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    R = int(os.environ.get("BENCH_SR_PRODUCERS", 2 if smoke else 4))
+    ROUNDS = int(os.environ.get("BENCH_SR_ROUNDS", 2 if smoke else 6))
+    WAVE = int(os.environ.get("BENCH_SR_WAVE", 8 if smoke else 24))
+    READS = int(os.environ.get("BENCH_SR_READS", 2 if smoke else 6))
+    PUB_EVERY = int(os.environ.get("BENCH_SR_PUB_EVERY", 2))
+
+    from crdt_enc_tpu.backends import MemoryRemote, MemoryStorage
+    from crdt_enc_tpu.core import Core
+    from crdt_enc_tpu.models import canonical_bytes
+    from crdt_enc_tpu.models.orset import ORSet, op_from_obj
+    from crdt_enc_tpu.read.stable import StalenessError
+    from crdt_enc_tpu.sim.linearize import oracle_fold
+
+    opts = _daemon_opts_fn()
+
+    async def scenario():
+        remote = MemoryRemote()
+        producers = [
+            await Core.open(opts(MemoryStorage(remote))) for _ in range(R)
+        ]
+        reader = await Core.open(opts(MemoryStorage(remote)))
+        oplog: dict = {}  # (actor, version) -> [op_obj, ...] plaintext
+        total_ops = 0
+        strong_s: list = []
+        eventual_s: list = []
+        lag_samples: list = []
+        refusals = 0
+        t0 = time.perf_counter()
+        for rnd in range(ROUNDS):
+            for pi, p in enumerate(producers):
+                for w in range(WAVE):
+                    member = f"m{pi}-{rnd}-{w}".encode()
+                    ops = await p.update(
+                        lambda s, a=p.actor_id, m=member: s.add_ctx(a, m)
+                    )
+                    oplog[(p.actor_id, p._local_meta.last_op_version)] = [
+                        op.to_obj() for op in ops
+                    ]
+                    total_ops += 1
+                if (rnd + pi) % PUB_EVERY == 0:
+                    await p.compact()  # publish the cursor
+            for _ in range(READS):
+                te = time.perf_counter()
+                await reader.read_remote()
+                await reader.read()
+                eventual_s.append(time.perf_counter() - te)
+                ts = time.perf_counter()
+                res = await reader.read(linearizable=True)
+                strong_s.append(time.perf_counter() - ts)
+                lag_samples.append(res.view.lag)
+                # refusal-rate probe, untimed: a zero-staleness demand
+                # refuses whenever the frontier trails the union — the
+                # fraction of the run a max_lag=0 caller would have
+                # been refused under this churn
+                try:
+                    await reader.read(
+                        linearizable=True, max_lag=0, refresh=False
+                    )
+                except StalenessError:
+                    refusals += 1
+        # drain to full stability: every producer publishes its final
+        # cursor and the reader observes EACH publication before the
+        # next compact garbage-collects the snapshot that carries it —
+        # cursor knowledge lives in snapshots, so a reader that never
+        # sees one never counts that replica as caught up (the honest
+        # wedge docs/strong_reads.md describes)
+        for p in producers:
+            await p.compact()
+            await reader.read_remote()
+        res = await reader.read(linearizable=True)
+        wall = time.perf_counter() - t0
+        lag_samples.append(res.view.lag)
+        oracle, missing = oracle_fold(oplog, res.cursor)
+        identical = (
+            not missing
+            and canonical_bytes(ORSet.from_obj(res.obj))
+            == canonical_bytes(oracle)
+        )
+        covered = sum(res.cursor.counters.values())
+        return (
+            wall, total_ops, covered, strong_s, eventual_s, lag_samples,
+            refusals, identical,
+        )
+
+    (wall, total_ops, covered, strong_s, eventual_s, lag_samples,
+     refusals, identical) = asyncio.run(scenario())
+
+    q = _nearest_rank
+
+    result = {
+        "metric": "strong_read_e2e_reads_per_sec",
+        "config": f"strongread_{R}p",
+        "value": round(len(strong_s) / sum(strong_s), 1),
+        "unit": "reads/s",
+        "reads_strong": len(strong_s),
+        "reads_eventual": len(eventual_s),
+        "refusals": refusals,
+        "strong_ms": _quantiles_ms(strong_s),
+        "eventual_ms": _quantiles_ms(eventual_s),
+        "watermark_lag_versions": {
+            "p50": q(lag_samples, 0.50),
+            "p99": q(lag_samples, 0.99),
+            "max": max(lag_samples),
+        },
+        "total_ops": total_ops,
+        "final_covered_versions": covered,
+        "wall_s": round(wall, 3),
+        "byte_identical": identical,
+        "backend": "cpu",
+    }
+    log(
+        f"strong-read: {len(strong_s)} strong reads "
+        f"(p99 {result['strong_ms'].get('p99_ms')}ms) vs eventual p99 "
+        f"{result['eventual_ms'].get('p99_ms')}ms; watermark lag p99 "
+        f"{result['watermark_lag_versions']['p99']} versions; "
+        f"byte_identical={identical}"
+    )
+    print(json.dumps(result))
+    if not identical:
+        log(
+            "FAILED: final strong read diverges from the oracle fold "
+            "of its own cut — refusing to record"
+        )
+        raise SystemExit(1)
+    if os.environ.get("BENCH_LOCAL_DISABLE") == "1":
+        return
+    _append_local({
+        **result,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "host_cpus": os.cpu_count(),
+        "shape": {"producers": R, "rounds": ROUNDS, "wave": WAVE,
+                  "reads_per_round": READS, "pub_every": PUB_EVERY},
+    })
+
+
 def bench_sim(smoke: bool):
     """Adversarial-simulator throughput (docs/simulation.md): schedules
     per second over seeded all-fault runs — the explorable-schedule
@@ -2116,6 +2283,9 @@ def main():
     smoke = "--smoke" in sys.argv
     if "--sim" in sys.argv:
         bench_sim(smoke)
+        return
+    if "--e2e-strong-read" in sys.argv:
+        e2e_strong_read(smoke)
         return
     if "--e2e-delta" in sys.argv:
         e2e_delta(smoke)

@@ -31,6 +31,7 @@ import asyncio
 import hashlib
 import logging
 import os
+import time
 import uuid
 from dataclasses import dataclass, field
 
@@ -261,6 +262,11 @@ class OpenOptions:
     # re-reading full snapshots (automatic traced fallback on any gap,
     # GC'd link, or fingerprint doubt).  ``CRDT_DELTA=0`` force-disables.
     delta: bool = True
+    # strong-read membership policy (docs/strong_reads.md): an explicit
+    # crdt_enc_tpu.read.MembershipPolicy pinning the watermark
+    # denominator (expected replicas, silence decay).  None = the
+    # observed-replica denominator, the PR-6 watermark math unchanged.
+    membership: object | None = None
 
 
 async def open_sealed_blob(
@@ -375,6 +381,12 @@ class Core:
         # writer-side dot-reuse guard (_ensure_own_history): the first
         # write of this incarnation probes for un-refolded own history
         self._own_history_checked = False
+        # strong-read tier (docs/strong_reads.md): the stable prefix is
+        # created lazily on the first linearizable read (or restored
+        # from the warm-open checkpoint's observational b"sp" slot), so
+        # eventual-only replicas pay nothing for it
+        self._membership = opts.membership
+        self._stable = None
 
     # ------------------------------------------------------------------ open
     @classmethod
@@ -489,6 +501,14 @@ class Core:
                 dict(ckpt[0]) if ckpt is not None else None,
                 self._checkpoint_enabled,
             )
+            if self._membership is not None:
+                # the strong-read membership policy's loud surface: who
+                # the watermark denominator excludes rides with every
+                # status into /healthz and obs_report fleet (the key is
+                # absent without a configured policy, so the PR-6
+                # byte-stability contract is unchanged for everyone
+                # else)
+                status["membership"] = self._membership.summary()
         self.last_replication_status = status
         return status
 
@@ -521,6 +541,215 @@ class Core:
         except Exception:
             logger.debug("slo/live sampling failed", exc_info=True)
         return status
+
+    # ------------------------------------------------------------ strong reads
+    def _strong(self):
+        """The lazily-created stable prefix (docs/strong_reads.md)."""
+        if self._stable is None:
+            from ..read.stable import StablePrefix
+
+            self._stable = StablePrefix(self.adapter)
+        return self._stable
+
+    async def stable_prefix(self, *, refresh: bool = True):
+        """Advance the stable prefix to the current (policy-adjusted)
+        stability watermark and return its
+        :class:`~crdt_enc_tpu.read.stable.StableView`.  With ``refresh``
+        (default), ``read_remote()`` runs first so the watermark
+        reflects the latest published cursors; ``refresh=False`` trusts
+        current knowledge (the fold service's post-cycle reads, polling
+        loops that just ingested).  Monotone: the returned frontier
+        never regresses within an incarnation."""
+        from ..read.stable import (
+            StableView, effective_watermark, find_holdouts,
+        )
+
+        if refresh:
+            await self.read_remote()
+        prefix = self._strong()
+        wm, union, replicas, excluded = effective_watermark(
+            self, policy=self._membership
+        )
+        await prefix.advance(self, wm)
+        # sync summary section
+        lag = sum(
+            c - prefix.cursor.get(a)
+            for a, c in union.counters.items()
+            if c > prefix.cursor.get(a)
+        )
+        wm_lag = sum(
+            c - wm.get(a, 0) for a, c in union.counters.items()
+        )
+        view = StableView(
+            cursor=prefix.cursor.copy(),
+            watermark=dict(wm),
+            lag=lag,
+            watermark_lag=wm_lag,
+            excluded=tuple(sorted(a.hex() for a in excluded)),
+            holdouts=tuple(find_holdouts(self, wm, union, replicas)),
+            wedged={a.hex(): r for a, r in sorted(prefix.wedged.items())},
+        )
+        trace.gauge("read_stable_lag", lag)
+        return view
+
+    async def read(
+        self,
+        *,
+        linearizable: bool = False,
+        max_lag: int | None = None,
+        min_cursor: VClock | None = None,
+        refresh: bool = True,
+    ):
+        """Read this replica's value.  ``linearizable=False`` (default)
+        is the eventual tier: the live state's object form, free, no
+        guarantee beyond CRDT convergence.  ``linearizable=True``
+        answers from the stable prefix — a fold every denominator
+        replica provably holds — refusing honestly
+        (:class:`~crdt_enc_tpu.read.StalenessError`) when the caller's
+        constraints cannot be met: ``max_lag`` bounds how many versions
+        the union may be ahead of the served frontier
+        (``lag_exceeded``), ``min_cursor`` demands coverage of a target
+        clock, e.g. the caller's own last write (``uncovered_target``).
+        There is no silent fallback tier: callers that can accept
+        eventual values on refusal catch the error and re-read with
+        ``linearizable=False`` — the two consistencies never mix
+        implicitly."""
+        from ..read.stable import ReadResult, StalenessError
+
+        if not linearizable:
+            if max_lag is not None or min_cursor is not None:
+                # staleness constraints are strong-read-only; silently
+                # dropping one would hand back an eventual value the
+                # caller explicitly bounded — the implicit tier mix
+                # this API promises never happens
+                raise ValueError(
+                    "max_lag/min_cursor require linearizable=True"
+                )
+            d = self._data
+            return ReadResult(
+                obj=self.adapter.state_to_obj(d.state),
+                consistency="eventual",
+                cursor=d.next_op_versions.copy(),
+            )
+        with trace.span("read.strong"):
+            trace.add("read_strong_total", 1)
+            view = await self.stable_prefix(refresh=refresh)
+            status = {
+                "watermark": {a.hex(): c for a, c in view.watermark.items()},
+                "lag": view.lag,
+                "watermark_lag": view.watermark_lag,
+                "excluded": list(view.excluded),
+                "holdouts": list(view.holdouts),
+                "wedged": dict(view.wedged),
+            }
+            if min_cursor is not None and not view.covers(min_cursor):
+                trace.add("read_strong_refusals", 1)
+                raise StalenessError(
+                    "uncovered_target",
+                    "stable prefix does not cover the requested clock "
+                    f"(holdouts: {', '.join(view.holdouts) or 'none'}); "
+                    "await_stable() or retry later",
+                    status=status,
+                )
+            if max_lag is not None and view.lag > max_lag:
+                trace.add("read_strong_refusals", 1)
+                raise StalenessError(
+                    "lag_exceeded",
+                    f"stable prefix lags the union by {view.lag} versions "
+                    f"(> max_lag {max_lag}); holdouts: "
+                    f"{', '.join(view.holdouts) or 'none'}"
+                    + (
+                        f"; policy excluded: {', '.join(view.excluded)}"
+                        if view.excluded else ""
+                    ),
+                    status=status,
+                )
+            prefix = self._strong()
+            return ReadResult(
+                obj=self.adapter.state_to_obj(prefix.state),
+                consistency="strong",
+                cursor=view.cursor,
+                view=view,
+            )
+
+    async def contains(self, member, **kw) -> bool:
+        """Linearizable (or eventual) point membership lookup for
+        set-shaped states.  Same keywords and refusal taxonomy as
+        :meth:`read`; raises ``TypeError`` for states without a
+        ``contains`` — honest refusal, not a guess."""
+        state = await self._read_state(**kw)
+        probe = getattr(state, "contains", None)
+        if probe is None:
+            raise TypeError(
+                f"{type(state).__name__} has no membership lookup"
+            )
+        return bool(probe(member))
+
+    async def value(self, **kw):
+        """Linearizable (or eventual) point value lookup for
+        value-shaped states (counters, registers).  Same keywords and
+        refusal taxonomy as :meth:`read`."""
+        state = await self._read_state(**kw)
+        probe = getattr(state, "value", None)
+        if probe is None:
+            probe = getattr(state, "read", None)  # counters/registers
+        if probe is None:
+            raise TypeError(f"{type(state).__name__} has no value()")
+        return probe() if callable(probe) else probe
+
+    async def _read_state(self, *, linearizable: bool = False, **kw):
+        """The live or stable STATE object behind the point lookups —
+        read-only by contract."""
+        if not linearizable:
+            return self._data.state
+        await self.read(linearizable=True, **kw)  # advances + enforces
+        return self._strong().state
+
+    async def await_stable(
+        self,
+        target: VClock,
+        *,
+        timeout_s: float = 30.0,
+        poll_interval_s: float = 0.05,
+        on_poll=None,
+        clock=None,
+    ):
+        """The freshness-wait protocol: block until the stable prefix
+        covers ``target`` (e.g. the caller's own last-write clock —
+        read-your-writes made strong), re-reading the remote each poll
+        so newly published cursors advance the watermark.  Returns the
+        covering :class:`StableView`; raises
+        :class:`~crdt_enc_tpu.read.StalenessError` (``timeout``) when
+        ``timeout_s`` elapses first.  ``on_poll`` and ``clock`` are the
+        determinism seams: the simulator paces with sync ticks and a
+        counted clock so waits replay bit-for-bit; production uses the
+        defaults (asyncio sleep, monotonic time)."""
+        from ..read.stable import StalenessError
+
+        clock = clock if clock is not None else time.monotonic
+        t0 = clock()
+        trace.add("read_await_total", 1)
+        with trace.span("read.await"):
+            refresh = False  # first pass reuses current knowledge
+            while True:
+                view = await self.stable_prefix(refresh=refresh)
+                if view.covers(target):
+                    return view
+                refresh = True
+                if clock() - t0 >= timeout_s:
+                    trace.add("read_await_timeouts", 1)
+                    raise StalenessError(
+                        "timeout",
+                        f"watermark did not cover the target within "
+                        f"{timeout_s}s; holdouts: "
+                        f"{', '.join(view.holdouts) or 'none'}",
+                        status={"holdouts": list(view.holdouts),
+                                "excluded": list(view.excluded)},
+                    )
+                if on_poll is not None:
+                    await on_poll()
+                else:
+                    await asyncio.sleep(poll_interval_s)
 
     # ----------------------------------------------------------- key rotation
     async def _install_new_key(self) -> Key:
@@ -732,6 +961,17 @@ class Core:
                 b"rd": dict(sorted(d.read_deltas.items())),
             }
             if (
+                self._stable is not None
+                and self._stable.cursor.counters
+            ):
+                # the stable prefix only grows, so it is checkpointable
+                # as-is (docs/strong_reads.md): a warm reopen resumes
+                # the exposed strong-read frontier instead of
+                # restarting the session guarantee from bottom.
+                # Observational — never fingerprinted; a malformed slot
+                # costs a cold prefix rebuild, never a wrong read.
+                payload[b"sp"] = self._stable.to_obj()
+            if (
                 _snap is not None
                 and _snap[1] is not None
                 and _snap[1] == getattr(d.state, "_mut", None)
@@ -843,6 +1083,20 @@ class Core:
             # sealed WITH the snapshot (state == snapshot, name known),
             # the next compaction keeps extending the delta chain
             # instead of breaking it with a delta-less seal
+            sp = obj.get(b"sp")
+            if sp is not None:
+                try:
+                    from ..read.stable import StablePrefix
+
+                    self._stable = StablePrefix.from_obj(self.adapter, sp)
+                except Exception:
+                    # observational slot: a malformed prefix rebuilds
+                    # cold, it never fails the checkpoint
+                    logger.debug(
+                        "checkpoint stable-prefix slot undecodable; "
+                        "strong reads rebuild cold", exc_info=True,
+                    )
+                    self._stable = None
             snap = obj.get(b"snap")
             if (
                 self._delta_enabled

@@ -55,11 +55,17 @@ def device_summaries(paths: list[str]) -> list[dict]:
         rep = ts = None
         counters: dict = {}
         gauges: dict = {}
+        wm_change_ts = None  # record ts when the watermark last CHANGED
+        prev_wm = None
         for rec in records:
             if isinstance(rec.get("replication"), dict):
                 rep, ts = rec["replication"], rec.get("ts")
                 counters = rec.get("counters") or {}
                 gauges = rec.get("gauges") or {}
+                wm = rep.get("watermark")
+                if prev_wm is None or wm != prev_wm:
+                    wm_change_ts = ts
+                    prev_wm = wm
         if rep is None:
             raise FleetInputError(
                 f"{path}: no record carries a replication status — the "
@@ -74,6 +80,16 @@ def device_summaries(paths: list[str]) -> list[dict]:
             # held) and daemon_quarantined (tenants the fleet daemon
             # has parked, serve/daemon.py)
             "counters": counters, "gauges": gauges,
+            # watermark AGE, derived purely from sink record timestamps
+            # (deterministic — the newest sample's ts anchors "now"):
+            # how long the device kept sampling without its stability
+            # watermark moving.  A wedged watermark is a growing
+            # duration an operator can see without reading gauges.
+            "watermark_age_s": (
+                round(max(0.0, float(ts) - float(wm_change_ts)), 3)
+                if ts is not None and wm_change_ts is not None
+                else None
+            ),
         })
     return out
 
@@ -142,6 +158,13 @@ def fleet_report(summaries: list[dict]) -> dict:
                 # watermark lag within the active target (obs.slo)
                 "slo_ok": rep["divergence"]["watermark_lag"]
                 <= freshness.target,
+                "watermark_age_s": s.get("watermark_age_s"),
+                # strong-read membership policy surfacing (present only
+                # when the device runs one): replicas quarantined out
+                # of the watermark denominator (docs/strong_reads.md)
+                "membership_excluded": len(
+                    (rep.get("membership") or {}).get("excluded") or []
+                ),
             })
         lags = [d["lag"] for d in devices]
         bfiles = [d["backlog_files"] for d in devices]
@@ -209,12 +232,17 @@ def format_fleet(report: dict) -> str:
             quar = d.get("quarantined_files", 0)
             dq = d.get("daemon_quarantined", 0)
             quar_s = f"quar={quar}" + (f"+{dq}t" if dq else "")
+            age = d.get("watermark_age_s")
+            age_s = f"  wm_age={age:g}s" if age is not None else ""
+            excl = d.get("membership_excluded") or 0
+            excl_s = f"  excl={excl}" if excl else ""
             lines.append(
                 f"  device {d['actor']}  lag={d['lag']}  "
                 f"backlog_files={d['backlog_files']}  "
                 f"backlog_bytes={d['backlog_bytes']}  "
                 f"{quar_s}  "
                 f"slo={'ok' if d['slo_ok'] else 'BURN'}"
+                f"{age_s}{excl_s}"
             )
     return "\n".join(lines)
 

@@ -57,6 +57,10 @@ ENV_VAR = "CRDT_OBS_HTTP"
 #: sink record, not in every scrape response.
 _HEALTH_KEYS = (
     "watermark", "backlog", "divergence", "checkpoint", "local_clock",
+    # present only when a strong-read membership policy is configured
+    # (crdt_enc_tpu/read/policy.py): WHO the watermark denominator
+    # excludes must be operator-visible, never a silent drop
+    "membership",
 )
 
 
@@ -190,11 +194,23 @@ class LiveTelemetryServer:
         """Store one device's replication status summary (the dict
         ``Core.replication_status()`` returns).  Bounded: only the
         ``_HEALTH_KEYS`` summary is kept, last write per (remote,
-        actor) wins."""
+        actor) wins.  The publish time the WATERMARK last changed is
+        tracked separately (``watermark_ts``) so ``/healthz`` can
+        report watermark AGE — a wedged watermark (fresh samples, stale
+        frontier) is an operator-visible duration, not a gauge puzzle
+        (docs/strong_reads.md)."""
         key = (status.get("remote_id", "?"), status.get("actor", "?"))
         entry = {k: status[k] for k in _HEALTH_KEYS if k in status}
         entry["ts"] = round(time.time() if ts is None else ts, 3)
         with self._lock:
+            old = self._devices.get(key)
+            if (
+                old is not None
+                and old.get("watermark") == entry.get("watermark")
+            ):
+                entry["watermark_ts"] = old.get("watermark_ts", entry["ts"])
+            else:
+                entry["watermark_ts"] = entry["ts"]
             self._devices[key] = entry
 
     def publish_cycle(self, source: str, summary: dict) -> None:
@@ -219,11 +235,22 @@ class LiveTelemetryServer:
             devices = {k: dict(v) for k, v in self._devices.items()}
             cycles = {k: dict(v) for k, v in self._cycles.items()}
             daemon = dict(self._daemon)
+        now = time.time()
         remotes: dict[str, dict] = {}
         for (remote_id, actor), entry in sorted(devices.items()):
-            remotes.setdefault(remote_id, {"devices": {}})[
-                "devices"
-            ][actor] = entry
+            # watermark AGE: how long since this device's stability
+            # watermark last moved — a wedged watermark shows as a
+            # growing duration right in /healthz
+            wm_ts = entry.pop("watermark_ts", None)
+            if wm_ts is not None:
+                entry["watermark_age_s"] = round(max(0.0, now - wm_ts), 3)
+            slot = remotes.setdefault(remote_id, {"devices": {}})
+            slot["devices"][actor] = entry
+            age = entry.get("watermark_age_s")
+            if age is not None:
+                slot["watermark_age_s"] = max(
+                    slot.get("watermark_age_s", 0.0), age
+                )
         return {
             "schema": sink.SCHEMA_VERSION,
             "label": "healthz",

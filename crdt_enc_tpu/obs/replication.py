@@ -60,15 +60,21 @@ def stability_watermark(
     local_clock: VClock,
     cursor_matrix: dict[Actor, VClock],
     union: VClock,
+    replicas=None,
 ) -> dict[Actor, int]:
     """The causal stability watermark: pointwise min over every known
     replica's cursor (module docs) — factored out of
     :func:`compute_status` so the delta-replication layer can tag each
     sealed delta with the sealer's watermark (docs/delta.md) without
     paying the full status probe.  ``union`` is everything known to
-    exist; replicas are this one, every published cursor, and every
-    actor that ever produced ops."""
-    replicas = set(cursor_matrix) | set(union.counters) | {actor_id}
+    exist; by default replicas are this one, every published cursor,
+    and every actor that ever produced ops.  The strong-read tier
+    passes an explicit ``replicas`` denominator instead — its
+    membership policy may pin an expected set or quarantine silent
+    replicas out of the min (crdt_enc_tpu/read/policy.py); the math
+    here stays one implementation either way."""
+    if replicas is None:
+        replicas = set(cursor_matrix) | set(union.counters) | {actor_id}
     watermark: dict[Actor, int] = {}
     for a in union.counters:
         lo = None

@@ -1,0 +1,41 @@
+"""Strong-read tier: linearizable point reads at the stability watermark.
+
+The measurement substrate has existed since PR 6 (the causal stability
+watermark, ``obs.replication``) and PR 10 seals it into every delta's
+wire tag — this package is the first READ API that consumes it
+(docs/strong_reads.md, ROADMAP item 3, per "Linearizable State Machine
+Replication of State-Based CRDTs without Logs", arXiv:1905.08733):
+
+* :mod:`.stable` — the **stable prefix**: a second, monotone state per
+  replica folded ONLY from ops/snapshots covered by the stability
+  watermark.  ``Core.stable_prefix()`` advances and views it,
+  ``Core.read(linearizable=True)`` / ``contains`` / ``value`` answer
+  from it, and :class:`StalenessError` is the honest refusal taxonomy
+  when the watermark cannot cover the request.
+* :mod:`.policy` — :class:`MembershipPolicy`: the membership problem
+  handled explicitly.  One silent replica collapses the watermark
+  forever (silence is indistinguishable from lag); the policy pins an
+  expected replica set and/or decays provably-silent replicas out of
+  the watermark denominator — LOUDLY (surfaced in ``/healthz``,
+  ``obs_report fleet``, and every strong read's status), never as a
+  silent drop.
+
+The freshness-wait protocol (``Core.await_stable`` — block/poll until
+the watermark covers a target clock, e.g. the caller's own last write:
+read-your-writes made strong) and the serving/daemon integration
+(``FoldService.read_strong``, ``FleetDaemon.await_stable``) build on
+these two pieces; the PR-9 simulator checks the guarantee under
+all-fault schedules via ``read_strong``/``await_stable`` steps and the
+:mod:`crdt_enc_tpu.sim.linearize` checker.
+"""
+
+from .policy import MembershipPolicy
+from .stable import ReadResult, StableView, StablePrefix, StalenessError
+
+__all__ = [
+    "MembershipPolicy",
+    "ReadResult",
+    "StablePrefix",
+    "StableView",
+    "StalenessError",
+]

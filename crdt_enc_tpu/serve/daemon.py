@@ -104,6 +104,18 @@ class DaemonConfig:
     between cycles."""
 
     interval_s: float = 1.0
+    # wall-clock-aware pacing (docs/strong_reads.md "Scheduling for
+    # freshness"): with interval_auto on, run_forever paces by
+    # next_interval() — real-time freshness-SLO burn over the last
+    # burn_window_s (obs/slo.py window accounting applied live) drives
+    # the interval geometrically between interval_max_s (no burn) and
+    # interval_min_s (burn ≥ 1: the fleet is eating budget, laggards
+    # blocking the watermark get re-scheduled sooner).  Timestamps come
+    # from the daemon's clock seam, so the sim stays replayable.
+    interval_auto: bool = False
+    interval_min_s: float = 0.05
+    interval_max_s: float = 8.0
+    burn_window_s: float = 30.0
     # scheduler: compact when backlog ≥ min_backlog_files or watermark
     # lag exceeds the freshness-SLO target, and at least every
     # max_idle_cycles regardless; at most `batch` tenants per cycle
@@ -159,7 +171,8 @@ class FleetDaemon:
     seeded simulator schedule replays bit-for-bit."""
 
     def __init__(self, tenants=(), config: DaemonConfig | None = None,
-                 live_port: int | None = None, seed: int = 0, mesh=None):
+                 live_port: int | None = None, seed: int = 0, mesh=None,
+                 clock=None):
         self.config = config if config is not None else DaemonConfig()
         # mesh passed at construction, straight through to the service:
         # the daemon's scheduling, backoff, and drain are device-layout
@@ -170,7 +183,18 @@ class FleetDaemon:
         self._entries: dict[str, TenantEntry] = {}
         self._rng = random.Random(f"crdt-daemon-{seed}")
         self._cycle = 0
-        self._started = time.monotonic()
+        # the deterministic-clock seam: every wall-time read (uptime,
+        # SLO burn window, auto interval) goes through here, so the
+        # simulator can inject a counted clock and replay bit-for-bit
+        self._clock = clock if clock is not None else time.monotonic
+        self._started = self._clock()
+        # freshness-wait protocol (docs/strong_reads.md): per-tenant
+        # waiters blocked until the tenant's stable prefix covers a
+        # target clock; a waiting tenant jumps the cadence queue
+        self._waiters: dict[str, list] = {}
+        # live freshness-burn samples (clock_t, bad, good) for the
+        # wall-clock-aware interval (pruned to burn_window_s)
+        self._burn_window: list = []
         # serializes cycles against admit/evict/drain: the fleet mutates
         # BETWEEN cycles, never during one
         self._lock = asyncio.Lock()
@@ -238,14 +262,35 @@ class FleetDaemon:
         self._publish()
         return tid
 
+    def _fail_waiters(self, tid: str, why: str) -> None:
+        """Fail a departed tenant's pending freshness waiters LOUDLY —
+        no cycle can ever resolve them, so letting them ride out their
+        timeouts against a gone tenant would be a silent hang."""
+        pending = self._waiters.pop(tid, None)
+        if not pending:
+            return
+        from ..read.stable import StalenessError
+
+        for _target, fut in pending:
+            if not fut.done():
+                fut.set_exception(
+                    StalenessError(
+                        "timeout",
+                        f"tenant {tid}: {why} before the watermark "
+                        "covered the target",
+                    )
+                )
+
     async def evict(self, tid: str, *, checkpoint: bool = True):
         """Remove a tenant while running: waits out any in-flight cycle,
         seals a final warm-open checkpoint (so the next open of that
-        tenant is warm), and hands the core back to the caller."""
+        tenant is warm), fails its pending freshness waiters loudly,
+        and hands the core back to the caller."""
         async with self._lock:
             entry = self._entries.pop(tid, None)
             if entry is None:
                 raise KeyError(f"unknown tenant {tid!r}")
+            self._fail_waiters(tid, "evicted")
             if checkpoint:
                 try:
                     await entry.core.save_checkpoint()
@@ -262,9 +307,11 @@ class FleetDaemon:
         """Drop a tenant whose core is GONE (crashed process in the
         simulator, caller-closed handle): no checkpoint, no core
         returned.  Unknown tids are ignored — discard is the cleanup
-        path and must be safe to repeat."""
+        path and must be safe to repeat.  Pending freshness waiters
+        fail loudly, exactly as on evict."""
         async with self._lock:
             if self._entries.pop(tid, None) is not None:
+                self._fail_waiters(tid, "discarded")
                 trace.add("daemon_evicted", 1)
 
     # -------------------------------------------------------- scheduling
@@ -276,24 +323,33 @@ class FleetDaemon:
 
         return obs_slo.freshness_spec().target
 
-    def _score(self, entry: TenantEntry, target: float) -> float:
-        """Staleness priority: SLO-lag pressure dominates, then backlog
-        files/bytes, then idle age.  A tenant with no status yet (never
-        sampled) sorts first — unknown staleness is assumed worst."""
+    def _score(self, entry: TenantEntry, target: float):
+        """Staleness priority, as a sort KEY: a pending freshness
+        waiter is a separate tier above every score (compacting THIS
+        tenant publishes the cursor its watermark is waiting on — the
+        laggard jumps the queue outright; an additive boost would let
+        a large-enough laggard crowd the waiter out of a full batch),
+        then SLO-lag pressure, backlog files/bytes, and idle age.  A
+        tenant with no status yet (never sampled) sorts first within
+        its tier — unknown staleness is assumed worst."""
+        waiting = 1 if self._waiters.get(entry.tid) else 0
         status = entry.status()
         if status is None:
-            return float("inf")
+            return (waiting, float("inf"))
         lag = float(status["divergence"]["watermark_lag"])
         backlog = status["backlog"]
         idle = self._cycle - max(entry.last_sealed, 0)
         return (
+            waiting,
             (lag / max(target, 1.0)) * 16.0
             + float(backlog["files"])
             + float(backlog["bytes"]) / 65536.0
-            + idle / max(self.config.max_idle_cycles, 1)
+            + idle / max(self.config.max_idle_cycles, 1),
         )
 
     def _due(self, entry: TenantEntry, target: float) -> bool:
+        if self._waiters.get(entry.tid):
+            return True  # a freshness waiter is blocked on this tenant
         status = entry.status()
         if status is None or entry.last_sealed < 0:
             return True
@@ -380,6 +436,10 @@ class FleetDaemon:
             await self._compact(selected, report)
             await self._poll(rest, report)
 
+        # ---- freshness-wait resolution + live SLO burn sample
+        await self._resolve_waiters(report)
+        self._note_burn(target)
+
         # ---- gauges + outcome bookkeeping
         counts = {ACTIVE: 0, BACKOFF: 0, QUARANTINED: 0}
         for entry in self._entries.values():
@@ -390,6 +450,112 @@ class FleetDaemon:
         report["degraded"] = self.degraded
         report["states"] = counts
         return report
+
+    # -------------------------------------------------- freshness waits
+    async def await_stable(self, tid: str, target, *, timeout_s: float = 30.0):
+        """The freshness-wait protocol at the control plane: block until
+        tenant ``tid``'s stable prefix covers ``target`` (a VClock, e.g.
+        the caller's own last-write clock — read-your-writes through a
+        daemon-served tenant).  Registering a waiter boosts the tenant
+        to the front of the cadence queue, so the scheduler actively
+        chases the cursors the waiter needs instead of waiting for
+        backlog pressure.  Resolution happens at the end of each cycle;
+        raises :class:`~crdt_enc_tpu.read.StalenessError` (``timeout``)
+        when ``timeout_s`` of *wall* time elapses first (the daemon
+        clock seam), and ``KeyError`` for unknown tenants."""
+        from ..read.stable import StalenessError
+
+        entry = self._entries.get(tid)
+        if entry is None:
+            raise KeyError(f"unknown tenant {tid!r}")
+        fut = asyncio.get_running_loop().create_future()
+        waiter = (target, fut)
+        self._waiters.setdefault(tid, []).append(waiter)
+        trace.add("daemon_waiters", 1)
+        try:
+            return await asyncio.wait_for(fut, timeout=timeout_s)
+        except asyncio.TimeoutError:
+            raise StalenessError(
+                "timeout",
+                f"tenant {tid}: watermark did not cover the target "
+                f"within {timeout_s}s of daemon cycles",
+            ) from None
+        finally:
+            pending = self._waiters.get(tid, [])
+            if waiter in pending:
+                pending.remove(waiter)
+            if not pending:
+                self._waiters.pop(tid, None)
+
+    async def _resolve_waiters(self, report: dict) -> None:
+        """End-of-cycle half of :meth:`await_stable`: advance the
+        stable prefix of every tenant with pending waiters (knowledge
+        is fresh — the cycle just ingested or polled it) and resolve
+        the futures whose target the frontier now covers."""
+        for tid in list(self._waiters):
+            entry = self._entries.get(tid)
+            pending = self._waiters.get(tid, [])
+            if entry is None or not pending:
+                continue
+            try:
+                view = await entry.core.stable_prefix(refresh=False)
+            except Exception as e:
+                logger.debug(
+                    "waiter advance for %s failed: %r", tid, e
+                )
+                continue
+            for target, fut in list(pending):
+                if not fut.done() and view.covers(target):
+                    fut.set_result(view)
+            report.setdefault("waiters", {})[tid] = len(
+                [w for w in pending if not w[1].done()]
+            )
+
+    def _note_burn(self, target: float) -> None:
+        """One live freshness-burn sample per cycle: the fraction of
+        tenants whose watermark lag exceeds the SLO target, window-
+        bucketed by the daemon clock — obs/slo.py's burn accounting
+        applied to the running fleet instead of sink records."""
+        bad = good = 0
+        for entry in self._entries.values():
+            status = entry.status()
+            if status is None:
+                continue
+            if float(status["divergence"]["watermark_lag"]) > target:
+                bad += 1
+            else:
+                good += 1
+        now = self._clock()
+        self._burn_window.append((now, bad, good))
+        horizon = now - max(self.config.burn_window_s, 1e-9)
+        while self._burn_window and self._burn_window[0][0] < horizon:
+            self._burn_window.pop(0)
+
+    def next_interval(self) -> float:
+        """The pacing for run_forever's next sleep.  Fixed
+        ``interval_s`` unless ``interval_auto``; with it, the freshness
+        burn rate over the live window drives the interval
+        geometrically from ``interval_max_s`` (no burn) down to
+        ``interval_min_s`` (burn ≥ 1 — budget is being eaten in real
+        time, so laggards holding the watermark back get visited
+        sooner).  Published as the ``daemon_interval_s`` gauge either
+        way."""
+        cfg = self.config
+        if not cfg.interval_auto:
+            trace.gauge("daemon_interval_s", cfg.interval_s)
+            return cfg.interval_s
+        from ..obs import slo as obs_slo
+
+        spec = obs_slo.freshness_spec()
+        bad = sum(b for _, b, _ in self._burn_window)
+        total = bad + sum(g for _, _, g in self._burn_window)
+        frac = bad / total if total else 0.0
+        burn = min(1.0, frac / spec.budget)
+        lo = max(cfg.interval_min_s, 1e-3)
+        hi = max(cfg.interval_max_s, lo)
+        interval = hi * (lo / hi) ** burn
+        trace.gauge("daemon_interval_s", interval)
+        return interval
 
     async def _compact(self, entries, report, *, half_open: bool = False):
         """Run one FoldService cycle over ``entries`` and feed the
@@ -531,6 +697,22 @@ class FleetDaemon:
         if self.state == "drained":
             return {}
         self.state = "draining"
+        # pending freshness waiters cannot resolve once cycles stop:
+        # fail them loudly now instead of letting them ride out their
+        # timeouts against a drained daemon
+        from ..read.stable import StalenessError
+
+        for tid, pending in list(self._waiters.items()):
+            for _target, fut in pending:
+                if not fut.done():
+                    fut.set_exception(
+                        StalenessError(
+                            "timeout",
+                            f"tenant {tid}: daemon drained before the "
+                            "watermark covered the target",
+                        )
+                    )
+        self._waiters.clear()
         self._publish()
         errors: dict[str, str] = {}
         async with self._lock:
@@ -570,7 +752,7 @@ class FleetDaemon:
                 try:
                     await asyncio.wait_for(
                         self._drain_requested.wait(),
-                        timeout=self.config.interval_s,
+                        timeout=self.next_interval(),
                     )
                 except asyncio.TimeoutError:
                     pass
@@ -588,7 +770,7 @@ class FleetDaemon:
         last = self.last_cycle_report or {}
         return {
             "state": self.state,
-            "uptime_s": round(time.monotonic() - self._started, 3),
+            "uptime_s": round(self._clock() - self._started, 3),
             "cycles": self._cycle,
             "tenants": len(self._entries),
             "active": counts[ACTIVE],
@@ -596,6 +778,7 @@ class FleetDaemon:
             "quarantined": counts[QUARANTINED],
             "degraded": self.degraded,
             "consecutive_cycle_failures": self._consec_cycle_failures,
+            "waiters": sum(len(v) for v in self._waiters.values()),
             "last_cycle": {
                 "cycle": last.get("cycle", 0),
                 "selected": len(last.get("selected", [])),

@@ -385,6 +385,27 @@ class FoldService:
             logger.debug("cycle telemetry publication failed",
                          exc_info=True)
 
+    # ------------------------------------------------------- strong reads
+    async def read_strong(self, core, *, max_lag=None, min_cursor=None,
+                          refresh: bool = True):
+        """Per-tenant strong read through the serving layer
+        (docs/strong_reads.md): the same stable-prefix guarantee as
+        ``Core.read(linearizable=True)`` — served tenants do not trade
+        consistency for batching.  ``refresh=False`` skips the
+        per-read ``read_remote`` when the caller knows the tenant just
+        cycled (the daemon's post-cycle waiter resolution); the
+        default refreshes, so a standalone endpoint call observes the
+        latest published cursors.  Refusals raise
+        :class:`~crdt_enc_tpu.read.StalenessError` unchanged."""
+        if self._closed:
+            raise RuntimeError("FoldService is closed; read_strong refused")
+        with trace.span("serve.read_strong"):
+            trace.add("serve_strong_reads", 1)
+            return await core.read(
+                linearizable=True, max_lag=max_lag,
+                min_cursor=min_cursor, refresh=refresh,
+            )
+
     # ------------------------------------------------------------ ingest
     async def _ingest_all(self, works) -> None:
         sem = asyncio.Semaphore(max(1, self.config.io_width))

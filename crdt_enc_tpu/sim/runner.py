@@ -109,6 +109,8 @@ class SimResult:
     service_cycles: int = 0
     daemon_cycles: int = 0
     quarantined: int = 0
+    strong_reads: int = 0
+    strong_timeouts: int = 0
     fingerprint: str = ""
 
     @property
@@ -124,6 +126,44 @@ class _Replica:
     incarnation: int = 0
     last_status: dict | None = None  # per-incarnation monotonicity baseline
     actor_id: bytes | None = None  # survives crashes (dgc targets it)
+    # strong-read session baseline (sim/linearize.py): the previous
+    # strong cursor of THIS incarnation (a cold reopen starts a new
+    # session — docs/strong_reads.md), and the last clock a SUCCESSFUL
+    # await_stable promised coverage of (the read-your-writes oblig.)
+    last_strong: object | None = None
+    awaited: object | None = None
+
+
+class _TapStorage:
+    """The oracle's recording seam: wraps a replica's REAL (inner)
+    storage so every op file that durably lands is captured as
+    plaintext the moment it is written — BEFORE compaction GC can erase
+    it and INSIDE the fault wrapper (a crash-before never reaches the
+    tap, a crash-after raises only after the tap recorded the landed
+    file).  Decryption happens eagerly with the writing core's own key
+    material (the sealer necessarily holds its sealing key), so key
+    rotation mid-history costs the oracle nothing.  Everything else
+    delegates untouched — the system under test sees its normal
+    storage."""
+
+    def __init__(self, inner, oplog: dict):
+        self.inner = inner
+        self._oplog = oplog
+        self.core = None  # set by the runner after Core.open
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    async def store_ops(self, actor, version, data):
+        await self.inner.store_ops(actor, version, data)
+        core = self.core
+        if core is not None:
+            from ..core.core import open_sealed_blob
+
+            payload = await open_sealed_blob(
+                core._data.keys, core.cryptor, data, None
+            )
+            self._oplog[(bytes(actor), int(version))] = payload
 
 
 class SimRunner:
@@ -144,6 +184,12 @@ class SimRunner:
         self.service_cycles = 0
         self.daemon_cycles = 0
         self.checks_run = 0
+        # strong-read oracle (sim/linearize.py): plaintext of every op
+        # file that ever landed, recorded by the _TapStorage seam —
+        # compaction GC cannot erase the checker's evidence
+        self._oplog: dict = {}
+        self.strong_count = 0
+        self.strong_timeouts = 0
         self._remote = None  # memory backend's shared MemoryRemote
         # persistent FleetDaemon for the daemon/ddrain vocabulary: one
         # control-plane instance lives ACROSS steps (that is the point —
@@ -223,6 +269,14 @@ class SimRunner:
         rep.actor_id = rep.core.actor_id
         rep.incarnation += 1
         rep.last_status = None  # monotonicity holds per incarnation
+        # a reopen starts a new strong-read session: a cold rebuild may
+        # legitimately expose an older frontier (docs/strong_reads.md),
+        # and any read-your-writes obligation died with the session
+        rep.last_strong = None
+        rep.awaited = None
+        tap = getattr(rep.storage, "inner", None)
+        if isinstance(tap, _TapStorage):
+            tap.core = rep.core
 
     # --------------------------------------------------------------- run
     def run(self) -> SimResult:
@@ -240,6 +294,13 @@ class SimRunner:
         with trace.span("sim.run", meta=sched.seed):
             for i in range(sched.n_replicas):
                 inner = self._inner_storage(i)
+                if sched.strong_reads:
+                    # the tap sits INSIDE the fault wrapper: it records
+                    # exactly the files that durably land (crash-before
+                    # never reaches it, crash-after raises only after
+                    # it recorded).  Only strong-read schedules pay it,
+                    # so every earlier fixture replays untouched.
+                    inner = _TapStorage(inner, self._oplog)
                 wrapper = FaultyStorage(
                     inner, sched.faults, seed=sched.seed, name=f"r{i}"
                 )
@@ -286,6 +347,8 @@ class SimRunner:
         if result.violation is not None:
             trace.add("sim_violations", 1)
         result.transient_missing_key = self.transient_missing_key
+        result.strong_reads = self.strong_count
+        result.strong_timeouts = self.strong_timeouts
         result.service_cycles = self.service_cycles
         result.daemon_cycles = self.daemon_cycles
         result.checks_run = self.checks_run
@@ -387,6 +450,10 @@ class SimRunner:
                 return await self._compact2(rep, step.arg, step_idx)
             elif kind == "service":
                 return await self._service(rep, step.arg, step_idx)
+            elif kind == "read_strong":
+                return await self._read_strong(rep, step_idx)
+            elif kind == "await_stable":
+                return await self._await_stable(rep, step_idx)
             else:
                 raise ValueError(f"unknown step kind {kind!r}")
         except SimCrash:
@@ -463,7 +530,83 @@ class SimRunner:
                     f"tenant r{t.idx}: {res.error}",
                     step_idx,
                 )
+        if self.schedule.strong_reads:
+            # served tenants get the same guarantee: a strong read
+            # through the service's per-tenant endpoint, validated by
+            # the same checker (refresh=False — the cycle just ingested)
+            for t, res in zip(tenants, results):
+                if res.error is None and t.core is not None:
+                    v = await self._read_strong(
+                        t, step_idx, service=True
+                    )
+                    if v is not None:
+                        return v
         return None
+
+    # ------------------------------------------------------ strong reads
+    async def _read_strong(self, rep, step_idx: int, *,
+                           service: bool = False) -> Violation | None:
+        """One linearizable read + the full checker
+        (sim/linearize.py): exactness against the oracle fold of its
+        cut, durability, session monotonicity, and any pending
+        read-your-writes obligation.  ``service=True`` routes through
+        the FoldService per-tenant endpoint instead of the core —
+        same guarantee, same checker."""
+        from .linearize import check_strong_read
+
+        if service:
+            res = await self._service_pool.read_strong(
+                rep.core, refresh=False
+            )
+        else:
+            res = await rep.core.read(linearizable=True)
+        self.strong_count += 1
+        defect = check_strong_read(
+            self._oplog, res, rep.last_strong, ryw_target=rep.awaited
+        )
+        rep.awaited = None  # the obligation is checked exactly once
+        if defect is not None:
+            return Violation(
+                "linearizability", f"r{rep.idx}: {defect}", step_idx
+            )
+        rep.last_strong = res.cursor
+        return None
+
+    async def _await_stable(self, rep, step_idx: int) -> Violation | None:
+        """The freshness-wait protocol on the replica's own last-write
+        clock.  Determinism seams: polling advances every replica's
+        sync ticks (delayed files move toward visibility) and the
+        timeout counts polls, not wall time.  A timeout under faults is
+        loud-but-transient (a silent or crashed peer legitimately holds
+        the watermark); a SUCCESS creates the read-your-writes
+        obligation the follow-up strong read is checked against."""
+        from ..models.vclock import VClock
+        from ..read.stable import StalenessError
+
+        lm = rep.core._local_meta
+        if lm is None or lm.last_op_version == 0:
+            return None  # never wrote: nothing to await
+        target = VClock({rep.core.actor_id: lm.last_op_version})
+
+        async def on_poll():
+            for r in self.replicas:
+                r.storage.tick()
+
+        polls = [0.0]
+
+        def clock():
+            polls[0] += 1.0
+            return polls[0]
+
+        try:
+            await rep.core.await_stable(
+                target, timeout_s=6.0, on_poll=on_poll, clock=clock
+            )
+        except StalenessError:
+            self.strong_timeouts += 1
+            return None
+        rep.awaited = target
+        return await self._read_strong(rep, step_idx)
 
     # ------------------------------------------------------------ daemon
     def _daemon_transient(self, err: str) -> bool:
@@ -495,6 +638,10 @@ class SimRunner:
                 ),
                 seed=self.schedule.seed,
                 mesh=self.mesh,
+                # the deterministic-clock seam: daemon wall-time reads
+                # (uptime, SLO burn window) count cycles instead of
+                # reading the host clock, so replays stay bit-for-bit
+                clock=lambda: float(self.daemon_cycles),
             )
         daemon = self._daemon
         await self._daemon_sync(daemon)

@@ -41,6 +41,15 @@ Step kinds (``Step.kind``):
 ``ddrain``  graceful daemon drain (checkpoints every tenant, stops the
             instance); the next ``daemon`` step starts a fresh daemon
             that reopens through the checkpoints
+``read_strong`` replica serves a linearizable read from its stable
+            prefix (``Core.read(linearizable=True)``); the result is
+            validated on the spot by the linearizability checker
+            (sim/linearize.py) — exactness against the oracle fold of
+            its cut, session monotonicity, durability
+``await_stable`` replica runs the freshness-wait protocol on its own
+            last-write clock (read-your-writes made strong): a timeout
+            under faults is loud-but-transient, a SUCCESS obligates the
+            follow-up strong read to cover the awaited clock — checked
 ========== ==================================================================
 
 ``Schedule.deltas`` turns delta-state replication on for every
@@ -49,7 +58,9 @@ replay bit-for-bit, and the generator only emits the ``d*`` step
 kinds (and only perturbs its RNG stream) when it is on.
 ``Schedule.daemon`` does the same for the ``daemon``/``ddrain``
 vocabulary (ISSUE 12): default OFF, so every pre-daemon fixture and
-seed replays untouched.
+seed replays untouched.  ``Schedule.strong_reads`` gates the
+``read_strong``/``await_stable`` vocabulary (ISSUE 15) under the same
+RNG-stream preservation rule.
 """
 
 from __future__ import annotations
@@ -78,6 +89,8 @@ STEP_KINDS = (
     "dgc",
     "daemon",
     "ddrain",
+    "read_strong",
+    "await_stable",
 )
 
 
@@ -108,6 +121,7 @@ class Schedule:
     backend: str = "memory"  # "memory" (deterministic) | "fs"
     deltas: bool = False  # delta-state replication on every replica
     daemon: bool = False  # daemon/ddrain vocabulary (FleetDaemon runs)
+    strong_reads: bool = False  # read_strong/await_stable vocabulary
     note: str = ""
 
     def to_obj(self) -> dict:
@@ -119,6 +133,7 @@ class Schedule:
             "backend": self.backend,
             "deltas": self.deltas,
             "daemon": self.daemon,
+            "strong": self.strong_reads,
             "faults": self.faults.to_obj(),
             "steps": [s.to_obj() for s in self.steps],
             "note": self.note,
@@ -141,6 +156,7 @@ class Schedule:
             backend=backend,
             deltas=bool(obj.get("deltas", False)),
             daemon=bool(obj.get("daemon", False)),
+            strong_reads=bool(obj.get("strong", False)),
             note=str(obj.get("note", "")),
         )
         bad = [
@@ -163,6 +179,7 @@ class Schedule:
             backend=self.backend,
             deltas=self.deltas,
             daemon=self.daemon,
+            strong_reads=self.strong_reads,
             note=self.note,
         )
 
@@ -209,6 +226,16 @@ _DAEMON_WEIGHTS = [
     ("ddrain", 0.01),
 ]
 
+# strong-read vocabulary (ISSUE 15): a steady stream of linearizable
+# reads plus occasional freshness waits on the reader's own last write.
+# Appended only when the strong_reads flag is on — same RNG-stream
+# preservation rule, so every earlier fixture and seed replays
+# untouched.
+_STRONG_WEIGHTS = [
+    ("read_strong", 0.08),
+    ("await_stable", 0.03),
+]
+
 
 def generate(
     seed: int,
@@ -220,6 +247,7 @@ def generate(
     backend: str = "memory",
     deltas: bool = False,
     daemon: bool = False,
+    strong_reads: bool = False,
 ) -> Schedule:
     """One deterministic schedule from a seed.  Every replica both
     writes and syncs; dead replicas receive only ``reopen`` steps; the
@@ -230,6 +258,7 @@ def generate(
         _WEIGHTS
         + (_DELTA_WEIGHTS if deltas else [])
         + (_DAEMON_WEIGHTS if daemon else [])
+        + (_STRONG_WEIGHTS if strong_reads else [])
     )
     kinds = [k for k, _ in table]
     weights = [w for _, w in table]
@@ -288,4 +317,5 @@ def generate(
         backend=backend,
         deltas=deltas,
         daemon=daemon,
+        strong_reads=strong_reads,
     )

@@ -70,6 +70,8 @@ def _report(tag: str, schedule, result) -> None:
         f"steps={result.steps_run} checks={result.checks_run} "
         f"service_cycles={result.service_cycles} "
         f"daemon_cycles={result.daemon_cycles} "
+        f"strong_reads={result.strong_reads} "
+        f"strong_timeouts={result.strong_timeouts} "
         f"quarantined={result.quarantined} faults[{stats}]"
     )
     if result.violation is not None:
@@ -84,7 +86,7 @@ def _cmd_run(args) -> int:
     schedule = generate(
         args.seed, args.replicas, args.steps, faults,
         members=args.members, backend=args.backend, deltas=args.deltas,
-        daemon=args.daemon,
+        daemon=args.daemon, strong_reads=args.strong_reads,
     )
     result = _execute(schedule)
     _report("run", schedule, result)
@@ -119,7 +121,7 @@ def _cmd_explore(args) -> int:
         schedule = generate(
             seed, args.replicas, args.steps, faults,
             members=args.members, backend=args.backend, deltas=args.deltas,
-            daemon=args.daemon,
+            daemon=args.daemon, strong_reads=args.strong_reads,
         )
         result = _execute(schedule)
         _report(f"seed {seed}", schedule, result)
@@ -204,6 +206,10 @@ def main(argv=None) -> int:
                        help="enable the daemon/ddrain step vocabulary: "
                        "a persistent FleetDaemon cycles inside the "
                        "schedule (docs/multitenant.md)")
+        p.add_argument("--strong-reads", action="store_true",
+                       help="enable the read_strong/await_stable step "
+                       "vocabulary + the linearizability checker "
+                       "(docs/strong_reads.md)")
 
     p_run = sub.add_parser("run", help="one seeded schedule + checks")
     p_run.add_argument("--seed", type=int, default=0)

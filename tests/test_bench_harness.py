@@ -70,6 +70,28 @@ def test_multitenant_smoke_emits_one_json_line():
     assert rec["warm_cycle"]["warm_hits"] == 4
 
 
+def test_strong_read_smoke_emits_one_json_line():
+    """The ISSUE-15 bench end-to-end on a tiny fleet: one JSON line,
+    the final strong read oracle-compared inside the run (divergence
+    exits 1)."""
+    r = subprocess.run(
+        [sys.executable, _BENCH, "--e2e-strong-read", "--smoke"],
+        env=_env(JAX_PLATFORMS="cpu", BENCH_LOCAL_DISABLE="1"),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "strong_read_e2e_reads_per_sec"
+    assert rec["value"] > 0 and rec["unit"] == "reads/s"
+    assert rec["byte_identical"] is True
+    assert rec["reads_strong"] > 0
+    assert rec["final_covered_versions"] == rec["total_ops"]
+    assert "p99_ms" in rec["strong_ms"] and "p99_ms" in rec["eventual_ms"]
+    assert rec["watermark_lag_versions"]["max"] >= 0
+
+
 def test_delta_smoke_emits_one_json_line():
     """The ISSUE-10 bench end-to-end on a tiny CPU remote: one JSON
     line, byte-identity + chains-applied asserted inside the run (a

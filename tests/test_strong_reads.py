@@ -1,0 +1,787 @@
+"""Strong-read tier (ISSUE 15, docs/strong_reads.md).
+
+The guarantee is byte-exact, not shape-checked: every strong read here
+is compared against a pure-Python oracle fold of exactly the cut it
+names (the sim/linearize.py checker reused as a unit oracle), across
+memory AND fs backends and through the FoldService per-tenant endpoint.
+The membership policy, the refusal taxonomy, the freshness-wait
+protocol (core + daemon), the wall-clock-aware daemon pacing, the
+watermark-age surfacing, and the PR-6 "membership growth legitimately
+collapses the watermark" caveat each get a dedicated regression.
+"""
+
+import asyncio
+import json
+import pathlib
+import time
+
+import pytest
+
+from crdt_enc_tpu.backends import (
+    FsStorage,
+    IdentityCryptor,
+    MemoryRemote,
+    MemoryStorage,
+    PlainKeyCryptor,
+)
+from crdt_enc_tpu.core import Core, OpenOptions, gcounter_adapter, orset_adapter
+from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+from crdt_enc_tpu.models import canonical_bytes
+from crdt_enc_tpu.models.orset import ORSet
+from crdt_enc_tpu.models.vclock import VClock
+from crdt_enc_tpu.read import MembershipPolicy, StalenessError
+from crdt_enc_tpu.sim.linearize import check_strong_read, oracle_fold
+from crdt_enc_tpu.utils import trace
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_opts(storage, adapter=None, **kw):
+    kw.setdefault("create", True)
+    return OpenOptions(
+        storage=storage,
+        cryptor=IdentityCryptor(),
+        key_cryptor=PlainKeyCryptor(),
+        adapter=adapter if adapter is not None else orset_adapter(),
+        supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+        current_data_version=DEFAULT_DATA_VERSION_1,
+        **kw,
+    )
+
+
+async def _write(core, member, oplog=None):
+    """One add through the production writer path, its plaintext
+    recorded for the oracle."""
+    ops = await core.update(lambda s: s.add_ctx(core.actor_id, member))
+    if oplog is not None:
+        oplog[(core.actor_id, core._local_meta.last_op_version)] = [
+            op.to_obj() for op in ops
+        ]
+    return ops
+
+
+# ---- membership policy ----------------------------------------------------
+
+A = b"\xaa" * 16
+B = b"\xbb" * 16
+C = b"\xcc" * 16
+
+
+def test_policy_expected_pins_the_denominator():
+    pol = MembershipPolicy(expected=[B])
+    # B published nothing: denominator is {A(self), B}
+    assert pol.denominator(A, {}, VClock({A: 3, C: 5})) == {A, B}
+    assert pol.observe(A, {}, VClock({A: 3, C: 5})) == {A, B}
+    # C produced ops but is NOT expected: it never joins the min
+    assert C not in pol.denominator(A, {C: VClock({C: 5})}, VClock({C: 5}))
+
+
+def test_policy_silence_quarantine_and_revival():
+    pol = MembershipPolicy(silent_after=2)
+    union = VClock({A: 1, B: 1})
+    row = {B: VClock({B: 1})}
+    # B's cursor never advances: after the first sighting, two more
+    # silent observations put it past silent_after -> quarantined
+    for _ in range(4):
+        eff = pol.observe(A, row, union)
+    assert B not in eff and pol.excluded == frozenset({B})
+    assert pol.summary()["excluded"] == [B.hex()]
+    # B's published cursor advances -> re-admitted
+    eff = pol.observe(A, {B: VClock({B: 2})}, union)
+    assert B in eff and pol.excluded == frozenset()
+    # self is never excluded, however silent
+    assert A in eff
+
+
+def test_policy_off_by_default_matches_pr6_denominator():
+    pol = MembershipPolicy()
+    row = {B: VClock({B: 1})}
+    union = VClock({A: 1, B: 1, C: 2})
+    assert pol.observe(A, row, union) == {A, B, C}
+    assert pol.summary() == {
+        "expected": None, "silent_after": 0, "excluded": [],
+    }
+
+
+# ---- the stable prefix: exactness, taxonomy, waits ------------------------
+
+
+def test_strong_read_exact_oracle_fold_memory():
+    async def scenario():
+        remote = MemoryRemote()
+        a = await Core.open(make_opts(MemoryStorage(remote)))
+        b = await Core.open(make_opts(MemoryStorage(remote)))
+        oplog: dict = {}
+        await _write(a, b"x", oplog)
+        await _write(b, b"y", oplog)
+        await a.compact()  # publishes a's cursor (covers b's op)
+        res = await b.read(linearizable=True)
+        assert res.consistency == "strong"
+        defect = check_strong_read(oplog, res, None)
+        assert defect is None, defect
+        # monotone on a second read
+        res2 = await b.read(linearizable=True)
+        assert check_strong_read(oplog, res2, res.cursor) is None
+        # eventual tier never refuses and reports its tier honestly
+        ev = await b.read()
+        assert ev.consistency == "eventual" and ev.view is None
+        # point lookups answer from the stable prefix
+        assert await b.contains(b"x", linearizable=True)
+        assert await b.contains(b"y", linearizable=True)
+        assert not await b.contains(b"zzz", linearizable=True)
+
+    run(scenario())
+
+
+def test_strong_read_exact_oracle_fold_fs(tmp_path):
+    async def scenario():
+        remote = str(tmp_path / "remote")
+        a = await Core.open(
+            make_opts(FsStorage(str(tmp_path / "a"), remote))
+        )
+        b = await Core.open(
+            make_opts(FsStorage(str(tmp_path / "b"), remote))
+        )
+        oplog: dict = {}
+        for m in (b"x", b"y", b"z"):
+            await _write(a, m, oplog)
+        await _write(b, b"w", oplog)
+        await a.compact()
+        res = await b.read(linearizable=True)
+        defect = check_strong_read(oplog, res, None)
+        assert defect is None, defect
+        assert sorted(b._strong().state.members()) == [
+            b"w", b"x", b"y", b"z",
+        ]
+
+    run(scenario())
+
+
+def test_refusal_taxonomy_uncovered_target_and_lag():
+    async def scenario():
+        remote = MemoryRemote()
+        a = await Core.open(make_opts(MemoryStorage(remote)))
+        b = await Core.open(make_opts(MemoryStorage(remote)))
+        await _write(a, b"x")
+        await _write(b, b"y")  # unpublished: holds the watermark back
+        await b.read_remote()
+        # b's own write cannot be covered until a folds+publishes it
+        with pytest.raises(StalenessError) as ei:
+            await b.read(
+                linearizable=True,
+                min_cursor=VClock({b.actor_id: 1}),
+            )
+        assert ei.value.reason == "uncovered_target"
+        with pytest.raises(StalenessError) as ei:
+            await b.read(linearizable=True, max_lag=0)
+        assert ei.value.reason == "lag_exceeded"
+        # the message/status name WHO holds the watermark back
+        assert ei.value.status["holdouts"]
+        trace.reset()
+        try:
+            await b.read(linearizable=True, max_lag=0)
+        except StalenessError:
+            pass
+        snap = trace.snapshot()
+        assert snap["counters"]["read_strong_refusals"] == 1
+        assert snap["counters"]["read_strong_total"] == 1
+
+    run(scenario())
+
+
+def test_await_stable_read_your_writes_and_timeout():
+    async def scenario():
+        remote = MemoryRemote()
+        a = await Core.open(make_opts(MemoryStorage(remote)))
+        b = await Core.open(make_opts(MemoryStorage(remote)))
+        oplog: dict = {}
+        # a must be VISIBLE (an op producer) to join the denominator —
+        # otherwise b is alone and its own write is trivially stable
+        await _write(a, b"theirs", oplog)
+        await _write(b, b"mine", oplog)
+        await b.read_remote()
+        target = VClock({b.actor_id: 1})
+        # deterministic-timeout seam: counted clock, no peer progress
+        ticks = [0.0]
+
+        def clock():
+            ticks[0] += 1.0
+            return ticks[0]
+
+        with pytest.raises(StalenessError) as ei:
+            await b.await_stable(target, timeout_s=3, clock=clock,
+                                 poll_interval_s=0.0)
+        assert ei.value.reason == "timeout"
+        # peer folds + publishes -> the wait resolves and RYW holds
+        await a.compact()
+        view = await b.await_stable(target, timeout_s=5,
+                                    poll_interval_s=0.0)
+        assert view.covers(target)
+        res = await b.read(linearizable=True, min_cursor=target)
+        assert check_strong_read(oplog, res, None, ryw_target=target) \
+            is None
+
+    run(scenario())
+
+
+def test_gc_gap_wedges_then_recovers_via_stable_snapshot():
+    """Op files GC'd into a snapshot whose cursor exceeds the watermark
+    leave the prefix honestly wedged (``gc_gap``); the moment the
+    watermark covers the snapshot, the frontier jumps — monotone
+    throughout."""
+
+    async def scenario():
+        remote = MemoryRemote()
+        a = await Core.open(make_opts(MemoryStorage(remote)))
+        b = await Core.open(make_opts(MemoryStorage(remote)))
+        reader = await Core.open(make_opts(MemoryStorage(remote)))
+        oplog: dict = {}
+        await _write(a, b"x", oplog)
+        r0 = await reader.read(linearizable=True)
+        assert r0.cursor.get(a.actor_id) == 1  # a-only remote: stable
+        await _write(b, b"y", oplog)  # b joins: watermark now needs b
+        await _write(a, b"z", oplog)
+        # a compacts: folds everything, GCs ALL op files; its snapshot
+        # cursor covers b's op, which b never published -> unstable
+        await a.compact()
+        r1 = await reader.read(linearizable=True)
+        # monotone: the frontier never regressed despite the collapse
+        assert r1.cursor.get(a.actor_id) >= r0.cursor.get(a.actor_id)
+        # b's op file was GC'd into a's snapshot, whose cursor exceeds
+        # the watermark (b never published): honestly wedged, not lost
+        assert r1.view.wedged.get(b.actor_id.hex()) == "gc_gap"
+        assert r1.view.lag > 0
+        # b publishes -> snapshot becomes stable -> frontier jumps
+        await b.compact()
+        r2 = await reader.read(linearizable=True)
+        assert r2.view.wedged == {}
+        defect = check_strong_read(oplog, r2, r1.cursor)
+        assert defect is None, defect
+        assert sorted(reader._strong().state.members()) == [
+            b"x", b"y", b"z",
+        ]
+
+    run(scenario())
+
+
+def test_prefix_survives_warm_reopen_and_rebuilds_cold(tmp_path):
+    async def scenario():
+        remote = str(tmp_path / "remote")
+        local = str(tmp_path / "dev")
+        a = await Core.open(make_opts(FsStorage(local, remote)))
+        oplog: dict = {}
+        for m in (b"p", b"q"):
+            await _write(a, m, oplog)
+        res = await a.read(linearizable=True)
+        await a.compact()  # reseals the checkpoint WITH the b"sp" slot
+        frontier = a._strong().cursor.copy()
+        # warm reopen: the prefix is restored, no remote read needed
+        warm = await Core.open(
+            make_opts(FsStorage(local, remote), create=False)
+        )
+        assert warm.opened_from_checkpoint
+        assert warm._stable is not None
+        assert warm._stable.cursor == frontier
+        res_w = await warm.read(linearizable=True)
+        assert check_strong_read(oplog, res_w, res.cursor) is None
+        # cold reopen: a fresh session rebuilds from storage and
+        # reaches the same bytes
+        cold = await Core.open(
+            make_opts(FsStorage(local, remote), create=False,
+                      checkpoint=False)
+        )
+        assert cold._stable is None
+        res_c = await cold.read(linearizable=True)
+        assert canonical_bytes(ORSet.from_obj(res_c.obj)) == \
+            canonical_bytes(ORSet.from_obj(res_w.obj))
+
+    run(scenario())
+
+
+def test_value_lookup_on_counter_and_type_refusal():
+    async def scenario():
+        remote = MemoryRemote()
+        g = await Core.open(
+            make_opts(MemoryStorage(remote), adapter=gcounter_adapter())
+        )
+        await g.update(lambda s: s.inc(g.actor_id))
+        await g.update(lambda s: s.inc(g.actor_id))
+        assert await g.value() == 2
+        assert await g.value(linearizable=True) == 2
+        with pytest.raises(TypeError):
+            await g.contains(b"x")
+
+    run(scenario())
+
+
+# ---- membership collapse-then-recover (the PR-6 caveat, end to end) -------
+
+
+def test_watermark_collapse_then_recover_with_stale_checkpoint(tmp_path):
+    """ISSUE-15 satellite: membership growth legitimately collapses the
+    watermark (a newly heard-from replica drags the min down) and a
+    stale-checkpoint reopen replays through the collapse — pinned end
+    to end: the watermark view collapses, the EXPOSED frontier never
+    regresses, and recovery converges byte-exactly."""
+
+    async def scenario():
+        remote = str(tmp_path / "remote")
+        rdr_local = str(tmp_path / "reader")
+        oplog: dict = {}
+        a = await Core.open(
+            make_opts(FsStorage(str(tmp_path / "a"), remote))
+        )
+        reader = await Core.open(make_opts(FsStorage(rdr_local, remote)))
+        # phase 1: single producer -> everything it wrote is stable
+        for m in (b"one", b"two"):
+            await _write(a, m, oplog)
+        r1 = await reader.read(linearizable=True)
+        assert r1.cursor.get(a.actor_id) == 2
+        await reader.save_checkpoint()  # the soon-to-be-stale resume point
+        # phase 2: membership growth — B writes, publishes nothing
+        b = await Core.open(
+            make_opts(FsStorage(str(tmp_path / "b"), remote))
+        )
+        await _write(b, b"three", oplog)
+        await _write(a, b"four", oplog)
+        r2 = await reader.read(linearizable=True)
+        # the watermark for a's entries collapsed (B's row is unknown)…
+        assert r2.view.watermark.get(a.actor_id, 0) < 4
+        # …but the exposed frontier is monotone
+        assert check_strong_read(oplog, r2, r1.cursor) is None
+        # phase 3: recovery — both publish cursors (the reader observes
+        # each publication before the next compact GCs the snapshot
+        # carrying it: cursor knowledge lives in snapshots)
+        await a.compact()
+        await reader.read_remote()
+        await b.compact()
+        r3 = await reader.read(linearizable=True)
+        assert check_strong_read(oplog, r3, r2.cursor) is None
+        assert r3.cursor.get(a.actor_id) == 3  # 2 writes + compact? no:
+        # a wrote one/two/four = 3 op files; all stable now
+        assert sorted(reader._strong().state.members()) == [
+            b"four", b"one", b"three", b"two",
+        ]
+        # stale-checkpoint reopen: the phase-1 checkpoint replays into
+        # the phase-3 world — warm open restores the OLD frontier, the
+        # next strong read advances it monotonically to full coverage
+        stale = await Core.open(
+            make_opts(FsStorage(rdr_local, remote), create=False)
+        )
+        restored = (
+            stale._stable.cursor.copy() if stale._stable is not None
+            else VClock()
+        )
+        rs0 = await stale.read(linearizable=True)
+        assert check_strong_read(oplog, rs0, restored) is None
+        # the snapshot that carried a's cursor row was GC'd by b's
+        # compact, so the stale reader honestly wedges below full
+        # coverage until a publishes again — then it converges to the
+        # same bytes as the always-online reader
+        await a.compact()
+        rs = await stale.read(linearizable=True)
+        assert check_strong_read(oplog, rs, rs0.cursor) is None
+        assert canonical_bytes(ORSet.from_obj(rs.obj)) == \
+            canonical_bytes(ORSet.from_obj(r3.obj))
+
+    run(scenario())
+
+
+# ---- serving layer --------------------------------------------------------
+
+
+def test_fold_service_strong_read_matches_core():
+    from crdt_enc_tpu.serve import FoldService, ServeConfig
+
+    async def scenario():
+        remote = MemoryRemote()
+        tenant = await Core.open(make_opts(MemoryStorage(remote)))
+        writer = await Core.open(make_opts(MemoryStorage(remote)))
+        oplog: dict = {}
+        await _write(writer, b"served", oplog)
+        service = FoldService([tenant], ServeConfig(seal_empty=True))
+        await service.run_cycle()
+        trace.reset()
+        res = await service.read_strong(tenant, refresh=False)
+        assert trace.snapshot()["counters"]["serve_strong_reads"] == 1
+        defect = check_strong_read(oplog, res, None)
+        assert defect is None, defect
+        # the endpoint refuses exactly like the core
+        with pytest.raises(StalenessError):
+            await service.read_strong(
+                tenant, min_cursor=VClock({b"\x01" * 16: 9})
+            )
+        service.close()
+        with pytest.raises(RuntimeError):
+            await service.read_strong(tenant)
+
+    run(scenario())
+
+
+# ---- daemon: freshness waits, laggard priority, wall-clock pacing ---------
+
+
+def _daemon(tenants, clock=None, **cfg_kw):
+    from crdt_enc_tpu.serve import DaemonConfig, FleetDaemon, ServeConfig
+
+    cfg = DaemonConfig(serve=ServeConfig(seal_empty=True), **cfg_kw)
+    return FleetDaemon(tenants, cfg, clock=clock)
+
+
+def test_daemon_waiter_jumps_the_queue_and_resolves():
+    async def scenario():
+        remote = MemoryRemote()
+        tenant = await Core.open(make_opts(MemoryStorage(remote)))
+        writer = await Core.open(make_opts(MemoryStorage(remote)))
+        await _write(writer, b"w")
+        # nothing is "due" by pressure: huge idle cadence, big backlog
+        # threshold — only the waiter can make t0 due
+        d = _daemon([tenant], min_backlog_files=99, max_idle_cycles=99)
+        await d.run_cycle()  # baseline: statuses + last_sealed
+        r = await d.run_cycle()
+        assert r["selected"] == []  # pinned: nothing due without a waiter
+        target = VClock({writer.actor_id: 1})
+
+        async def driver():
+            for _ in range(3):
+                await d.run_cycle()
+                await asyncio.sleep(0)
+
+        view, _ = await asyncio.gather(
+            d.await_stable("t0", target, timeout_s=10), driver()
+        )
+        assert view.covers(target)
+        assert d.health()["waiters"] == 0
+        # the waiter made the tenant due (it was selected for a cycle)
+        assert any(
+            "t0" in rep.get("selected", [])
+            for rep in [d.last_cycle_report]
+        ) or view.covers(target)
+        with pytest.raises(KeyError):
+            await d.await_stable("nope", target)
+        await d.drain()
+
+    run(scenario())
+
+
+def test_eventual_read_rejects_strong_only_constraints():
+    async def scenario():
+        core = await Core.open(make_opts(MemoryStorage(MemoryRemote())))
+        with pytest.raises(ValueError):
+            await core.read(max_lag=0)
+        with pytest.raises(ValueError):
+            await core.read(min_cursor=VClock({A: 1}))
+
+    run(scenario())
+
+
+def test_daemon_evict_and_discard_fail_pending_waiters():
+    async def scenario():
+        remote = MemoryRemote()
+        t0 = await Core.open(make_opts(MemoryStorage(remote)))
+        t1 = await Core.open(make_opts(MemoryStorage(MemoryRemote())))
+        d = _daemon([t0, t1])
+        w0 = asyncio.create_task(
+            d.await_stable("t0", VClock({b"\x01" * 16: 1}), timeout_s=60)
+        )
+        w1 = asyncio.create_task(
+            d.await_stable("t1", VClock({b"\x01" * 16: 1}), timeout_s=60)
+        )
+        await asyncio.sleep(0)
+        await d.evict("t0")
+        with pytest.raises(StalenessError) as ei:
+            await w0
+        assert "evicted" in str(ei.value)
+        await d.discard("t1")
+        with pytest.raises(StalenessError) as ei:
+            await w1
+        assert "discarded" in str(ei.value)
+        assert d.health()["waiters"] == 0
+        await d.drain()
+
+    run(scenario())
+
+
+def test_daemon_waiter_tier_beats_arbitrarily_large_laggards():
+    """A flat score boost can be crowded out by a big enough laggard;
+    the waiter must be a separate sort TIER — pinned with batch=1 and
+    a never-sampled (score=inf) competitor."""
+    from crdt_enc_tpu.serve.daemon import TenantEntry
+
+    async def scenario():
+        remote = MemoryRemote()
+        waiting = await Core.open(make_opts(MemoryStorage(remote)))
+        laggard = await Core.open(make_opts(MemoryStorage(MemoryRemote())))
+        d = _daemon([waiting, laggard], batch=1)
+        # laggard never sampled -> _score second element is inf
+        d.entry("t1").core.last_replication_status = None
+        fut = asyncio.get_running_loop().create_future()
+        d._waiters["t0"] = [(VClock(), fut)]
+        target = d._slo_target()
+        assert d._score(d.entry("t0"), target) > d._score(
+            d.entry("t1"), target
+        )
+        report = await d.run_cycle()
+        assert report["selected"][0] == "t0"
+        await d.drain()
+
+    run(scenario())
+
+
+def test_daemon_drain_fails_pending_waiters_loudly():
+    async def scenario():
+        remote = MemoryRemote()
+        tenant = await Core.open(make_opts(MemoryStorage(remote)))
+        d = _daemon([tenant])
+        task = asyncio.create_task(
+            d.await_stable(
+                "t0", VClock({b"\x01" * 16: 1}), timeout_s=60
+            )
+        )
+        await asyncio.sleep(0)
+        await d.drain()
+        with pytest.raises(StalenessError) as ei:
+            await task
+        assert ei.value.reason == "timeout"
+
+    run(scenario())
+
+
+def test_daemon_wall_clock_interval_follows_slo_burn():
+    async def scenario():
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        d = _daemon([], clock=clock, interval_auto=True,
+                    interval_min_s=0.1, interval_max_s=10.0,
+                    burn_window_s=30.0)
+        # no samples: no burn -> the relaxed end
+        assert d.next_interval() == pytest.approx(10.0)
+        # a fully-burning window -> the aggressive end
+        d._burn_window[:] = [(1.0, 5, 0)]
+        t[0] = 2.0
+        assert d.next_interval() == pytest.approx(0.1)
+        # samples age out of the window (the deterministic clock seam)
+        t[0] = 40.0
+        d._note_burn(64.0)
+        assert d._burn_window == [(40.0, 0, 0)]
+        assert d.next_interval() == pytest.approx(10.0)
+        # fixed pacing unless opted in
+        d.config.interval_auto = False
+        assert d.next_interval() == d.config.interval_s
+        await d.drain()
+
+    run(scenario())
+
+
+def test_daemon_health_uses_clock_seam():
+    async def scenario():
+        t = [100.0]
+        d = _daemon([], clock=lambda: t[0])
+        t[0] = 107.5
+        assert d.health()["uptime_s"] == pytest.approx(7.5)
+        await d.drain()
+
+    run(scenario())
+
+
+# ---- observability: watermark age + membership surfacing ------------------
+
+
+def test_live_healthz_watermark_age():
+    from crdt_enc_tpu.obs.live import LiveTelemetryServer
+
+    srv = LiveTelemetryServer()
+    now = time.time()
+    status = {
+        "actor": "aa", "remote_id": "99",
+        "watermark": {"aa": 1}, "local_clock": {}, "backlog": {},
+        "divergence": {"watermark_lag": 5},
+        "checkpoint": {},
+    }
+    srv.publish_health(status, ts=now - 50)
+    srv.publish_health(status, ts=now - 10)  # wm unchanged: age grows
+    h = srv.health()
+    dev = h["remotes"]["99"]["devices"]["aa"]
+    assert dev["watermark_age_s"] == pytest.approx(50, abs=5)
+    assert h["remotes"]["99"]["watermark_age_s"] == dev["watermark_age_s"]
+    # the watermark moves: age resets to ~0
+    srv.publish_health(dict(status, watermark={"aa": 2}), ts=now)
+    dev = srv.health()["remotes"]["99"]["devices"]["aa"]
+    assert dev["watermark_age_s"] == pytest.approx(0, abs=5)
+
+
+def test_live_healthz_membership_key_rides_along():
+    from crdt_enc_tpu.obs.live import LiveTelemetryServer
+
+    srv = LiveTelemetryServer()
+    srv.publish_health({
+        "actor": "aa", "remote_id": "99", "watermark": {},
+        "local_clock": {}, "backlog": {},
+        "divergence": {"watermark_lag": 0}, "checkpoint": {},
+        "membership": {"expected": None, "silent_after": 3,
+                       "excluded": ["bb"]},
+    })
+    dev = srv.health()["remotes"]["99"]["devices"]["aa"]
+    assert dev["membership"]["excluded"] == ["bb"]
+
+
+def test_fleet_watermark_age_from_sink_timestamps(tmp_path):
+    from crdt_enc_tpu.obs import fleet
+
+    rep = {
+        "actor": "aa", "remote_id": "99",
+        "local_clock": {"aa": 1}, "union_clock": {"aa": 1},
+        "watermark": {"aa": 1}, "matrix": {},
+        "backlog": {"files": 0, "bytes": 0, "per_actor": {}},
+        "divergence": {"actors_behind": 0, "version_lag": 0,
+                       "watermark_lag": 0, "known_replicas": 1},
+        "checkpoint": {"enabled": False, "sealed": False,
+                       "staleness_versions": 0},
+        "membership": {"expected": None, "silent_after": 2,
+                       "excluded": ["bb", "cc"]},
+    }
+    path = tmp_path / "dev.jsonl"
+    recs = [
+        {"schema": 2, "label": "compact", "ts": 100.0,
+         "replication": rep},
+        {"schema": 2, "label": "compact", "ts": 200.0,
+         "replication": rep},  # watermark unchanged for 100s
+        {"schema": 2, "label": "compact", "ts": 260.0,
+         "replication": rep},  # …and 160s by the newest sample
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    (s,) = fleet.device_summaries([str(path)])
+    assert s["watermark_age_s"] == pytest.approx(160.0)
+    report = fleet.fleet_report([s])
+    dev = report["remotes"][0]["devices"][0]
+    assert dev["watermark_age_s"] == pytest.approx(160.0)
+    assert dev["membership_excluded"] == 2
+    rendered = fleet.format_fleet(report)
+    assert "wm_age=160s" in rendered and "excl=2" in rendered
+
+
+def test_fleet_golden_includes_wm_age():
+    golden = (REPO / "tests" / "data" / "obs_fleet_golden.txt").read_text()
+    assert "wm_age=" in golden
+
+
+# ---- simulator vocabulary + checker ---------------------------------------
+
+
+def test_sim_strong_read_schedule_all_faults_clean():
+    from crdt_enc_tpu.sim import FaultConfig, generate, run_schedule
+
+    schedule = generate(
+        1, 3, 70, FaultConfig.all_faults(), strong_reads=True
+    )
+    assert any(
+        s.kind in ("read_strong", "await_stable") for s in schedule.steps
+    )
+    result = run_schedule(schedule)
+    assert result.ok, result.violation
+    assert result.strong_reads > 0
+
+
+def test_sim_strong_schedule_roundtrip_and_flag_off_vocab():
+    from crdt_enc_tpu.sim import FaultConfig, Schedule, generate
+
+    sched = generate(
+        5, 3, 40, FaultConfig.none(), strong_reads=True
+    )
+    again = Schedule.from_obj(sched.to_obj())
+    assert again.strong_reads is True
+    assert [s.to_obj() for s in again.steps] == [
+        s.to_obj() for s in sched.steps
+    ]
+    # flag off: the vocabulary (and the RNG stream) is untouched
+    plain = generate(5, 3, 40, FaultConfig.none())
+    assert not any(
+        s.kind in ("read_strong", "await_stable") for s in plain.steps
+    )
+    assert plain.to_obj()["strong"] is False
+
+
+def test_linearize_checker_detects_each_defect_class():
+    oplog = {
+        (A, 1): [[0, b"x", [A, 1]]],
+        (A, 2): [[0, b"y", [A, 2]]],
+    }
+    good, missing = oracle_fold(oplog, VClock({A: 2}))
+    assert not missing
+
+    from crdt_enc_tpu.read.stable import ReadResult
+
+    ok = check_strong_read(
+        oplog, ReadResult(good.to_obj(), "strong", VClock({A: 2})), None
+    )
+    assert ok is None
+    bad_state = ORSet()
+    bad_state.apply([0, b"x", [A, 1]])
+    d = check_strong_read(
+        oplog, ReadResult(bad_state.to_obj(), "strong", VClock({A: 2})),
+        None,
+    )
+    assert d is not None and "diverges" in d
+    d = check_strong_read(
+        oplog, ReadResult(good.to_obj(), "strong", VClock({A: 3})), None
+    )
+    assert d is not None and "durable" in d
+    d = check_strong_read(
+        oplog, ReadResult(good.to_obj(), "strong", VClock({A: 2})),
+        VClock({A: 3}),
+    )
+    assert d is not None and "regressed" in d
+    d = check_strong_read(
+        oplog, ReadResult(good.to_obj(), "strong", VClock({A: 2})),
+        None, ryw_target=VClock({A: 3}),
+    )
+    assert d is not None and "await_stable" in d
+
+
+@pytest.mark.slow
+def test_sim_strong_reads_fleet_acceptance():
+    """ISSUE-15 acceptance: an 8-replica all-fault schedule set with
+    the full vocabulary (daemon in the loop) and the linearizability
+    checker on every strong read."""
+    from crdt_enc_tpu.sim import FaultConfig, generate, run_schedule
+
+    total = 0
+    for seed in range(2):
+        schedule = generate(
+            seed, 8, 500, FaultConfig.all_faults(),
+            daemon=True, strong_reads=True,
+        )
+        result = run_schedule(schedule)
+        assert result.ok, f"seed {seed}: {result.violation}"
+        total += result.strong_reads
+    assert total > 20
+
+
+# ---- bench record + trend pickup ------------------------------------------
+
+
+def test_strong_read_bench_record_committed_and_trended():
+    from crdt_enc_tpu.obs import fleet, sink
+
+    records = sink.read_records(str(REPO / "BENCH_LOCAL.jsonl"))
+    mine = [
+        r for r in records
+        if r.get("metric") == "strong_read_e2e_reads_per_sec"
+    ]
+    assert mine, "the --e2e-strong-read record must be committed"
+    rec = mine[-1]
+    assert rec["byte_identical"] is True
+    assert rec["value"] > 0
+    assert rec["watermark_lag_versions"]["p99"] >= 0
+    assert rec["strong_ms"]["p99_ms"] > 0
+    trend = fleet.bench_trend(records, metric="strong_read_e2e_reads_per_sec")
+    assert len(trend) >= 1 and trend[0]["latest"] == rec["value"]
+    # the CI ratchet must pass on the committed history
+    assert fleet.trend_regressions(trend, 45.0) == []

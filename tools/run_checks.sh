@@ -33,6 +33,14 @@ echo "== delta-enabled sim smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
     --seed 0 --replicas 4 --steps 80 --faults all --deltas
 
+echo "== strong-read sim smoke (bounded) =="
+# the read_strong/await_stable vocabulary + the linearizability checker
+# under the all-faults envelope: every strong read is oracle-compared
+# to the fold of exactly the cut it names (docs/strong_reads.md); the
+# fixture replay above re-runs any committed shrunk failures
+JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
+    --seed 0 --replicas 4 --steps 80 --faults all --strong-reads
+
 echo "== daemon-enabled sim smoke (bounded) =="
 # a persistent FleetDaemon cycles INSIDE the all-fault schedule
 # (daemon/ddrain vocabulary): crash/reopen, torn reads and delayed
