@@ -1450,6 +1450,245 @@ def e2e_daemon(smoke: bool):
     })
 
 
+def e2e_idle_cycle(smoke: bool):
+    """ISSUE-16 acceptance: the O(tail) steady state.
+
+    A T-tenant fleet (the daemon shape, BENCH_DMN_* knobs) is folded
+    once to seed warm planes + delta bases, then served at three ACTIVE
+    FRACTIONS — 100%, 10%, 1% of tenants receiving one new op file per
+    cycle — under two arms:
+
+    * ``continuation`` — the default :class:`ServeConfig`: warm planes
+      are the fold accumulator, quiet tenants no-op via the seal
+      signature (``serve_noop_cycles``), active tenants seal deltas by
+      device cut (``delta_device_cuts``).
+    * ``full_refold`` — ``ServeConfig(warm=False, noop_skip=False)``:
+      the O(state) steady state every cycle (quiet tenants re-seal
+      their whole snapshot; actives refold from the stored base).
+
+    The record's headline value is the 1%-active cycle-wall ratio
+    full_refold/continuation (≥10x is the ISSUE-16 bar).  Per-fraction
+    rows carry wall/cycle, per-quiet-tenant cost (an all-quiet cycle /
+    T), ``jax_compiles`` and ``h2d_bytes`` deltas over the measured
+    window, ``serve_noop_cycles`` and ``delta_base_bytes``.  After the
+    run EVERY tenant in BOTH arms must byte-match a fresh solo
+    ``Core.compact()`` of its remote — divergence refuses the record
+    (the standard e2e evidence guard)."""
+    import asyncio
+    import copy
+
+    T, N, R, E, OPF, _ = _daemon_fleet_shape(smoke)
+    FRACTIONS = (1.0, 0.1, 0.01)
+    CYC = int(os.environ.get("BENCH_IDLE_CYCLES", 2 if smoke else 3))
+
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    first_platform = platforms.split(",")[0].strip() if platforms else ""
+    want_tpu = first_platform not in ("cpu",) and not smoke
+    jax, dev = acquire_jax(want_tpu)
+
+    import crdt_enc_tpu
+    from crdt_enc_tpu.backends import (
+        MemoryRemote, MemoryStorage, PlainKeyCryptor, XChaChaCryptor,
+    )
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.models import canonical_bytes
+    from crdt_enc_tpu.obs import runtime as obs_runtime
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.serve import FoldService, ServeConfig
+    from crdt_enc_tpu.utils import trace
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    crdt_enc_tpu.enable_compilation_cache()
+    obs_runtime.track_recompiles()
+
+    def opts(storage):
+        return OpenOptions(
+            storage=storage,
+            cryptor=XChaChaCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+            accelerator=TpuAccelerator(),
+            delta=True,
+        )
+
+    # one drip file per active tenant per cycle: CYC measured cycles
+    # plus one untimed warmup cycle per fraction (the warmup settles
+    # the fraction's compile classes so the measured window is
+    # steady-state, not compile wall)
+    need_drip = len(FRACTIONS) * (CYC + 1)
+
+    async def build():
+        from benchmarks.suite import actor_bytes_table
+
+        # the drip writer is its own actor (one PAST the R plane
+        # replicas) so drip file versions never collide with head files
+        drip_ab = actor_bytes_table(R + 1)[R]
+        remotes, drips = [], []
+        for t in range(T):
+            files = _daemon_tenant_files(N, R, E, OPF, seed=900 + t)
+            # take files from the end until the tail holds at least one
+            # op per drip file (but always keep one head file)
+            n_tail, got = 0, 0
+            while got < need_drip and n_tail < len(files) - 1:
+                n_tail += 1
+                got += len(files[-n_tail][2])
+            n_tail = max(n_tail, min(len(files) - 1, len(files) // 3))
+            head, tail = files[:-n_tail], files[-n_tail:]
+            # re-chunk the tail's ops into exactly need_drip files (the
+            # op payload carries its own dot, so the drip writer can
+            # relay any actor's ops)
+            tail_ops = [op for _ab, _v, ops in tail for op in ops]
+            if len(tail_ops) < need_drip or not head:
+                raise SystemExit(
+                    f"shape too small: tenant {t} has {len(tail_ops)} "
+                    f"tail ops for a {need_drip}-file drip schedule"
+                )
+            step = len(tail_ops) / need_drip
+            cuts = [round(i * step) for i in range(need_drip + 1)]
+            remote = MemoryRemote()
+            writer = await Core.open(opts(MemoryStorage(remote)))
+            for ab, v, ops in head:
+                blob = await writer._seal(ops)
+                await writer.storage.store_ops(ab, v, blob)
+            drips.append([
+                (drip_ab, i + 1,
+                 await writer._seal(tail_ops[cuts[i]:cuts[i + 1]]))
+                for i in range(need_drip)
+            ])
+            remotes.append(remote)
+        return remotes, drips
+
+    remotes, drips = asyncio.run(build())
+    log(
+        f"e2e_idle_cycle: device {dev.platform}; {T} tenants, "
+        f"{CYC} cycles/fraction, fractions {FRACTIONS}"
+    )
+
+    async def run_arm(arm: str):
+        cfg = (ServeConfig() if arm == "continuation"
+               else ServeConfig(warm=False, noop_skip=False))
+        arm_remotes = [copy.deepcopy(r) for r in remotes]
+        cores = [
+            await Core.open(opts(MemoryStorage(r))) for r in arm_remotes
+        ]
+        service = FoldService(cores, cfg)
+        # seed cycle: folds every head, seals, stamps continuations
+        await service.run_cycle()
+        await service.run_cycle()  # settle compiles on the quiet shape
+
+        drip_pos = [0] * T
+        fraction_rows = []
+        obs_1pct = None
+        for frac in FRACTIONS:
+            n_active = max(1, round(T * frac))
+
+            async def drip_actives():
+                for t in range(n_active):
+                    ab, v, blob = drips[t][drip_pos[t]]
+                    drip_pos[t] += 1
+                    await cores[t].storage.store_ops(ab, v, blob)
+
+            # untimed warmup at THIS fraction's bucket shape
+            await drip_actives()
+            await service.run_cycle()
+            trace.reset()
+            walls = []
+            for _c in range(CYC):
+                await drip_actives()
+                t0 = time.perf_counter()
+                await service.run_cycle()
+                walls.append(time.perf_counter() - t0)
+            counters = trace.snapshot()["counters"]
+            gauges = trace.snapshot()["gauges"]
+            # all-quiet cycle: the pure per-quiet-tenant marginal
+            tq = time.perf_counter()
+            await service.run_cycle()
+            quiet_wall = time.perf_counter() - tq
+            row = {
+                "active_fraction": frac,
+                "active_tenants": n_active,
+                "wall_per_cycle_s": round(sorted(walls)[len(walls) // 2], 5),
+                "quiet_cycle_s": round(quiet_wall, 5),
+                "per_quiet_tenant_us": round(quiet_wall / T * 1e6, 2),
+                "jax_compiles": counters.get("jax_compiles", 0),
+                "h2d_bytes": counters.get("h2d_bytes", 0),
+                "serve_noop_cycles": counters.get("serve_noop_cycles", 0),
+                "delta_device_cuts": counters.get("delta_device_cuts", 0),
+                "delta_base_bytes": gauges.get("delta_base_bytes"),
+            }
+            if frac == 0.01 and arm == "continuation":
+                obs_1pct = trace.snapshot()
+            fraction_rows.append(row)
+
+        # fold any unused drip files so both arms end byte-comparable,
+        # then guard: every tenant must match a fresh solo compact
+        for t in range(T):
+            while drip_pos[t] < need_drip:
+                ab, v, blob = drips[t][drip_pos[t]]
+                drip_pos[t] += 1
+                await cores[t].storage.store_ops(ab, v, blob)
+        await service.run_cycle()
+        diverged = []
+        for i, core in enumerate(cores):
+            solo = await Core.open(
+                opts(MemoryStorage(copy.deepcopy(arm_remotes[i])))
+            )
+            await solo.compact()
+            if solo.with_state(canonical_bytes) != core.with_state(
+                canonical_bytes
+            ):
+                diverged.append(i)
+        service.close()
+        return fraction_rows, diverged, obs_1pct
+
+    async def scenario():
+        cont, div_c, obs_1pct = await run_arm("continuation")
+        full, div_f, _ = await run_arm("full_refold")
+        return cont, full, div_c + div_f, obs_1pct
+
+    cont, full, diverged, obs_1pct = asyncio.run(scenario())
+
+    by_frac = {r["active_fraction"]: r for r in full}
+    speedup = round(
+        by_frac[0.01]["wall_per_cycle_s"]
+        / max(cont[-1]["wall_per_cycle_s"], 1e-9), 2
+    )
+    result = {
+        "metric": "idle_cycle_speedup",
+        "config": f"idle_{T}t",
+        "value": speedup,
+        "unit": "x_at_1pct_active",
+        "continuation": cont,
+        "full_refold": full,
+        "byte_identical": not diverged,
+        "backend": dev.platform,
+    }
+    print(json.dumps(result))
+    if diverged:
+        log(
+            f"FAILED: tenants {sorted(set(diverged))[:5]} diverged from "
+            "solo compact() — refusing to record"
+        )
+        raise SystemExit(1)
+    if os.environ.get("BENCH_LOCAL_DISABLE") == "1":
+        return
+    if dev.platform != "tpu" and os.environ.get("BENCH_LOCAL_ALL") != "1":
+        return
+    _append_local({
+        **result,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "device_kind": dev.device_kind,
+        "host_cpus": os.cpu_count(),
+        "shape": {"tenants": T, "ops_per_tenant": N, "replicas": R,
+                  "members": E, "ops_per_file": OPF, "cycles": CYC},
+        "obs": obs_1pct,
+    })
+
+
 def e2e_warm_open(smoke: bool):
     """ISSUE-4 acceptance: cold open vs checkpointed (warm) open of a
     config-5-shaped un-compacted remote with a 1% op tail.
@@ -2304,6 +2543,9 @@ def main():
         return
     if "--e2e-daemon" in sys.argv:
         e2e_daemon(smoke)
+        return
+    if "--e2e-idle-cycle" in sys.argv:
+        e2e_idle_cycle(smoke)
         return
     N = int(os.environ.get("BENCH_OPS", 50_000 if smoke else 1_000_000))
     R = int(os.environ.get("BENCH_REPLICAS", 500 if smoke else 10_000))

@@ -378,6 +378,13 @@ class Core:
         self._delta_verify = os.environ.get("CRDT_DELTA_VERIFY", "") != "0"
         self._delta_base: dict | None = None
         self.last_delta_fallback_reason: str | None = None
+        # seal signature of the last _compact_seal (cursor + read sets +
+        # mutation epoch at snapshot time): the serving layer's
+        # no-op-cycle detector — when a quiet tenant's signature has not
+        # moved, re-sealing would publish the identical snapshot, so the
+        # whole seal/GC/checkpoint tail can be skipped honestly
+        # (docs/multitenant.md "cycle-cost law")
+        self._last_seal_sig: tuple | None = None
         # writer-side dot-reuse guard (_ensure_own_history): the first
         # write of this incarnation probes for un-refolded own history
         self._own_history_checked = False
@@ -2144,7 +2151,32 @@ class Core:
         return actors, files, groups
 
     # --------------------------------------------------------- delta sealing
-    def _plan_delta_seal(self, state_obj, cursor_obj):
+    @property
+    def delta_base_name(self) -> str | None:
+        """Content-addressed name of the retained diff base (the last
+        snapshot this replica sealed), or None.  The serving layer
+        matches it against a warm entry's ``seal_name`` to decide
+        whether a device-cut delta is possible this cycle."""
+        base = self._delta_base
+        return base["name"] if base is not None else None
+
+    def _seal_signature(self, _mut=None) -> tuple:
+        """Everything a re-seal of the current state would depend on:
+        the op cursor, the read snapshot/delta sets, and the state's
+        mutation epoch.  Two equal signatures ⇒ ``_compact_seal`` would
+        publish the identical snapshot + GC set, so the serving layer
+        may skip it.  ``_mut`` overrides the live epoch (callers pass
+        the SNAPSHOT-time epoch so a mutation landing mid-seal can
+        never alias the next cycle's comparison)."""
+        d = self._data
+        return (
+            tuple(sorted(d.next_op_versions.counters.items())),
+            frozenset(d.read_states),
+            tuple(sorted(d.read_deltas.items())),
+            getattr(d.state, "_mut", None) if _mut is None else _mut,
+        )
+
+    def _plan_delta_seal(self, state_obj, cursor_obj, _cut=None):
         """Sync section of the delta seal (docs/delta.md): diff the
         about-to-be-sealed state against the retained base (this
         replica's previous snapshot), self-verify, and hand the await
@@ -2180,6 +2212,34 @@ class Core:
         base = self._delta_base
         if base is None:
             return plan
+        if (
+            _cut is not None
+            and _cut.get("base_name") == base["name"]
+            and _cut.get("mut") == getattr(d.state, "_mut", None)
+        ):
+            # device-cut fast path (docs/delta.md "device-cut deltas"):
+            # the serving layer already compared base vs post-fold
+            # planes ON DEVICE and built the wire object from just the
+            # diff rows — no host dict walk, no need for host-resident
+            # base bytes.  The base planes ride in the plan so the
+            # seal-time self-verify can still rebuild the base and
+            # refold the delta against it.
+            plan["dobj"] = _cut["dobj"]
+            plan["base_planes"] = _cut.get("base_planes")
+            plan["base_name"] = base["name"]
+            plan["base_cursor"] = base["cursor"]
+            plan["device_cut"] = True
+            trace.add("delta_device_cuts", 1)
+            return plan
+        if base["bytes"] is None:
+            # the bytes were dropped by a prior device-cut seal and this
+            # cycle's cut does not line up (warm-tier eviction or a
+            # mut-epoch bump mid-continuation): seal one snapshot-only
+            # link — it re-anchors the chain AND re-retains the bytes,
+            # so the fallback is self-healing
+            trace.add("delta_cut_fallbacks", 1)
+            trace.add("delta_seal_skipped", 1)
+            return plan
         try:
             base_state = self.adapter.state_from_obj(
                 codec.unpack(base["bytes"])
@@ -2203,18 +2263,28 @@ class Core:
         plan["base_cursor"] = base["cursor"]
         return plan
 
-    def _set_delta_base(self, name: str, state_bytes: bytes, cursor_obj) -> None:
-        """Retain the just-sealed snapshot as the next diff base.  This
-        is a resident O(state) canonical copy per Core — deliberate
-        (the alternative is re-decrypting the sealed snapshot every
-        compact) but not free at fleet scale, so the cost is published
-        (``delta_base_bytes``, last-writer-wins across cores) and the
-        whole subsystem is opt-out (``OpenOptions.delta`` /
-        ``CRDT_DELTA=0``)."""
+    def _set_delta_base(
+        self, name: str, state_bytes: bytes | None, cursor_obj
+    ) -> None:
+        """Retain the just-sealed snapshot as the next diff base.
+        ``state_bytes`` is a resident O(state) canonical copy per Core —
+        deliberate (the alternative is re-decrypting the sealed snapshot
+        every compact) but not free at fleet scale, so the cost is
+        published (``delta_base_bytes``, last-writer-wins across cores)
+        and the whole subsystem is opt-out (``OpenOptions.delta`` /
+        ``CRDT_DELTA=0``).  A plane-resident tenant (one whose seal just
+        rode the device-cut path) passes ``state_bytes=None``: the warm
+        tier's device planes ARE the base, so no host copy is retained —
+        ``delta_base_bytes`` drops to ~0 and the next cycle either cuts
+        on device again or seals one snapshot-only link
+        (``delta_cut_fallbacks``) that re-retains the bytes."""
         self._delta_base = {
             "name": name, "bytes": state_bytes, "cursor": cursor_obj,
         }
-        trace.gauge("delta_base_bytes", len(state_bytes))
+        trace.gauge(
+            "delta_base_bytes",
+            0 if state_bytes is None else len(state_bytes),
+        )
 
     def _verify_delta_plan(self, plan) -> bool:
         """The refusal-to-publish guard (worker thread — the plan owns
@@ -2225,9 +2295,26 @@ class Core:
         opts out)."""
         with trace.span("delta.verify"):
             try:
-                plan["codec"].apply(plan["base_state"], plan["dobj"])
+                base_state = plan["base_state"]
+                if base_state is None:
+                    # device-cut plan: the host base copy was never
+                    # built — rebuild it from the plan-owned base
+                    # planes (normalized by the fold kernel's output
+                    # law; zero padding reconstructs to nothing)
+                    clock, add, rm, members, replicas = plan[
+                        "base_planes"
+                    ]
+                    import numpy as np
+
+                    from ..ops import orset_planes_to_state
+
+                    base_state = orset_planes_to_state(
+                        np.asarray(clock), np.asarray(add),
+                        np.asarray(rm), members, replicas,
+                    )
+                plan["codec"].apply(base_state, plan["dobj"])
                 return (
-                    codec.pack(self.adapter.state_to_obj(plan["base_state"]))
+                    codec.pack(self.adapter.state_to_obj(base_state))
                     == plan["new_bytes"]
                 )
             except Exception:
@@ -2313,7 +2400,15 @@ class Core:
                 await self.storage.remove_deltas(
                     [(self.actor_id, version - MAX_CHAIN)]
                 )
-        self._set_delta_base(name, plan["new_bytes"], plan["cursor"])
+        # a published device-cut proves the warm planes ARE this
+        # snapshot: drop the host base copy (the planes take over as
+        # the base; _plan_delta_seal's bytes-None branch covers any
+        # future cycle where they no longer line up)
+        self._set_delta_base(
+            name,
+            None if plan.get("device_cut") else plan["new_bytes"],
+            plan["cursor"],
+        )
 
     # --------------------------------------------------------------- compact
     async def compact(self) -> None:
@@ -2333,6 +2428,7 @@ class Core:
         self, *, _backlog: list | None = None,
         _packed_state: tuple | None = None,
         _state_obj: tuple | None = None,
+        _delta_cut: dict | None = None,
     ) -> None:
         """The seal tail of :meth:`compact`: snapshot the CURRENT state +
         cursor, write-new-then-delete-old, reseal the warm-open
@@ -2367,8 +2463,13 @@ class Core:
         cursor_obj = d.next_op_versions.to_obj()
         snap_mut = getattr(d.state, "_mut", None)
         # delta plan (diff + self-verify) in the SAME sync section: the
-        # (base, new, delta) triple must be cut from one stable state
-        delta_plan = self._plan_delta_seal(state_obj, cursor_obj)
+        # (base, new, delta) triple must be cut from one stable state.
+        # ``_delta_cut`` is the serving layer's device-cut candidate —
+        # validated (base name + mut epoch) inside the plan, never
+        # trusted blindly
+        delta_plan = self._plan_delta_seal(
+            state_obj, cursor_obj, _cut=_delta_cut
+        )
         payload = [
             state_obj,
             cursor_obj,
@@ -2412,6 +2513,11 @@ class Core:
         # sync bookkeeping section
         d.read_states.difference_update(states_to_remove)
         d.read_states.add(name)
+        # record what this seal depended on, AT the snapshot epoch: the
+        # serving layer skips the next seal iff the signature has not
+        # moved (a mutation landing mid-seal keeps the epochs apart, so
+        # the skip can never alias it away)
+        self._last_seal_sig = self._seal_signature(_mut=snap_mut)
         if self._checkpoint_enabled:
             # the freshly compacted state is the ideal warm-open resume
             # point: everything folded, op logs GC'd to the cursor

@@ -91,6 +91,53 @@ def orset_delta_diff(base: ORSet, new: ORSet):
     }
 
 
+def orset_delta_from_rows(
+    rows, *, members, replicas, row_width, base_clock, new_clock
+):
+    """Build the Orswot window delta from DEVICE-CUT diff rows instead
+    of the host dict walk: ``rows`` is the (idx, code, add_base,
+    add_new, rm_new) tuple :func:`ops.orset.orset_plane_diff_rows`
+    gathered (already D2H, plain integer arrays), ``members`` /
+    ``replicas`` are the shared vocab item lists the planes were
+    indexed by, ``row_width`` is the padded replica width the flat
+    indices were raveled with, and the clocks are the dense base/new
+    clock rows.  Emits byte-for-byte the object
+    :func:`orset_delta_diff` would (the canonical packer sorts map
+    keys, so insertion order never reaches the sealed bytes); the
+    differential tests pin that identity per storage backend and mesh
+    shape."""
+    from ..ops.orset import DIFF_ADD, DIFF_HORIZON, DIFF_REMOVED
+
+    idx, code, add_b, add_n, rm_n = rows
+    adds: dict = {}
+    removed: dict = {}
+    horizons: dict = {}
+    for i in range(len(idx)):
+        k = int(code[i])
+        if not k:
+            continue  # sentinel slot past the real diff count
+        e, r = divmod(int(idx[i]), row_width)
+        member = members[e]
+        rep = replicas[r]
+        if k & DIFF_ADD:
+            adds.setdefault(member, {})[rep] = int(add_n[i])
+        if k & DIFF_REMOVED:
+            removed.setdefault(member, {})[rep] = int(add_b[i])
+        if k & DIFF_HORIZON:
+            horizons.setdefault(member, {})[rep] = int(rm_n[i])
+    return {
+        b"bc": {
+            replicas[r]: int(c) for r, c in enumerate(base_clock) if c
+        },
+        b"c": {
+            replicas[r]: int(c) for r, c in enumerate(new_clock) if c
+        },
+        b"e": adds,
+        b"x": removed,
+        b"t": horizons,
+    }
+
+
 def orset_delta_apply(state: ORSet, obj) -> None:
     """Fold one Orswot window delta into ``state`` (module docs)."""
     bc = VClock.from_obj(obj.get(b"bc"))

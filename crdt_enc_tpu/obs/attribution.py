@@ -63,7 +63,10 @@ _SERVE_STAGES: dict[str, tuple[tuple[str, ...], ...]] = {
     "h2d": (),
     "fold": (("serve.fold", "serve.shard"),),  # shard = mesh mega-fold
     "scatter": (("serve.scatter",),),
-    "seal": (("serve.seal",),),
+    # delta.cut (device-cut delta build, disjoint from serve.scatter)
+    # and serve.continue (post-seal warm-entry stamping) are seal-phase
+    # work: separate groups because they never nest inside serve.seal
+    "seal": (("serve.seal",), ("delta.cut",), ("serve.continue",)),
 }
 
 

@@ -274,6 +274,95 @@ def orset_fold_tenants(
     return jax.vmap(one)(clock0, add0, rm0, kind, member, actor, counter)
 
 
+# Diff-row code bits (orset_plane_diff): which wire-form map a diff cell
+# feeds — the Orswot window delta's ``e`` / ``x`` / ``t`` keys
+# (delta/codec.orset_delta_diff).  A cell can set the add and horizon
+# bits together in principle (they read different planes); add and
+# removed are mutually exclusive by construction (``add_n > clock_b``
+# needs ``add_n > 0``, removed needs ``add_n == 0``).
+DIFF_ADD = 1  # surviving window dot: add_n > base clock (new adds AND
+#               confirmations that keep a window dot alive)
+DIFF_REMOVED = 2  # dot-exact removal: base slot absent from new
+DIFF_HORIZON = 4  # remove horizon raised past the base's
+
+
+@jax.jit
+def orset_plane_diff(clock_b, add_b, rm_b, clock_n, add_n, rm_n):
+    """Device cut of the Orswot window delta (docs/delta.md): compare a
+    sealed BASE state's planes against the post-fold NEW planes and mark
+    every cell the host dict-walk ``delta.codec.orset_delta_diff`` would
+    emit.  Returns ``(code, count)`` — an int8 code plane (DIFF_* bits)
+    and the number of nonzero cells — so the caller can size the
+    O(diff-rows) gather (:func:`orset_plane_diff_rows`) and D2H only the
+    rows that feed the wire form, never the full planes.
+
+    Both plane sets must be canonical (the fold/merge kernels' output
+    law: entries killed where add ≤ rm, rm zeroed where rm ≤ clock) and
+    indexed by ONE shared vocabulary; zero-padded cells are absent in
+    both states and can never mark.  The bit conditions are exactly the
+    host walk's comprehensions:
+
+    * add: ``add_n > clock_b[r]`` — slots in ``new.entries`` whose dot
+      lies past the base clock (``c > bc.get(r)``), including unchanged
+      survivors (the confirmations);
+    * removed: ``add_b > 0 and add_n == 0`` — base slots with no slot in
+      ``new`` (``not new_slots.get(r, 0)``), dot-exact with the base
+      counter as the value;
+    * horizon: ``rm_n > rm_b and rm_n > clock_n[r]`` — deferred removes
+      raised past the base's (``h > base_hs.get(r, 0)``) and still ahead
+      of the new clock (canonical planes imply the second clause; it is
+      kept so the kernel never depends on the caller normalizing).
+    """
+    add_bit = (add_n > clock_b[None, :]).astype(jnp.int8) * DIFF_ADD
+    rm_bit = (
+        (add_b > 0) & (add_n == 0)
+    ).astype(jnp.int8) * DIFF_REMOVED
+    hz_bit = (
+        (rm_n > rm_b) & (rm_n > clock_n[None, :])
+    ).astype(jnp.int8) * DIFF_HORIZON
+    code = add_bit | rm_bit | hz_bit
+    return code, jnp.sum(code != 0, dtype=jnp.int32)
+
+
+@jax.jit
+def orset_plane_diff_tenants(clock_b, add_b, rm_b, clock_n, add_n, rm_n):
+    """The serving layer's batched twin of :func:`orset_plane_diff`:
+    one dispatch marks a whole bucket's diff cells (``vmap`` over the
+    tenant axis, the mega-fold discipline), and the per-tenant counts
+    come home in one (T,) D2H instead of T scalar syncs."""
+    return jax.vmap(orset_plane_diff)(
+        clock_b, add_b, rm_b, clock_n, add_n, rm_n
+    )
+
+
+@partial(jax.jit, static_argnames=("size",))
+def orset_plane_diff_rows(code, add_b, add_n, rm_n, *, size):
+    """Gather ONE tenant's diff rows from its code plane: the flat cell
+    indices (row-major, so ``divmod(idx, R)`` recovers ``(e, r)``) plus
+    the code and the three counter values the wire builder needs
+    (``delta.codec.orset_delta_from_rows``).  ``size`` is the static
+    row capacity — the caller quantizes the phase-1 count through the
+    repo's ``_bucket`` law, so compile classes stay bounded by
+    log(E·R), not by diff contents.  Slots past the real count carry
+    ``idx == code.size`` (out of range) and zero values."""
+    flat = code.ravel()
+    n = flat.shape[0]
+    (idx,) = jnp.nonzero(flat, size=size, fill_value=n)
+    safe = jnp.minimum(idx, n - 1)
+    live = idx < n
+
+    def take(plane):
+        return jnp.where(live, plane.ravel()[safe], 0)
+
+    return (
+        idx,
+        take(flat),
+        take(add_b),
+        take(add_n),
+        take(rm_n),
+    )
+
+
 def merge_rule(clock_a, add_a, rm_a, clock_b, add_b, rm_b, clock_merged):
     """The clock-filter merge on raw arrays (clocks already row-broadcast
     ready, ``clock_merged = max(clock_a, clock_b)`` supplied by the

@@ -496,6 +496,53 @@ def gcounter_fold_tenants_sharded(
     return fold(clock0, actor, counter)
 
 
+def tenant_plane_diff_sharded(
+    mesh: Mesh,
+    clock_b,  # (T, R) int32 — per-tenant BASE clocks (last sealed)
+    add_b,  # (T, E, R) int32 — per-tenant BASE planes
+    rm_b,  # (T, E, R) int32
+    clock_n,  # (T, R) int32 — per-tenant post-fold clocks
+    add_n,  # (T, E, R) int32 — per-tenant post-fold planes
+    rm_n,  # (T, E, R) int32
+):
+    """Mesh-sharded twin of ``ops.orset.orset_plane_diff_tenants`` for
+    the device-cut delta seal (docs/delta.md): tenant lanes over ``dp``,
+    member slices over ``mp`` — the SAME layout the fold twin just left
+    the planes in, so the diff dispatch reads them where they already
+    live.  The per-cell code is embarrassingly shard-local (every bit
+    condition reads one cell plus the replicated clock rows); only the
+    per-tenant count crosses shards, as one ``psum`` over mp.  Same
+    bucket-class law as the fold: shapes are planner-quantized, so churn
+    never recompiles."""
+    dp = mesh.shape["dp"]
+    mp = mesh.shape["mp"]
+    T, E, R = add_n.shape
+    if T % dp or E % mp:
+        raise ValueError(
+            f"pad first: tenants {T} % dp {dp} or members {E} % mp {mp}"
+        )
+
+    def body(cb, ab, rb, cn, an, rn):
+        code, count = jax.vmap(K.orset_plane_diff)(cb, ab, rb, cn, an, rn)
+        return code, jax.lax.psum(count, "mp")
+
+    diff = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P("dp", None),
+            P("dp", "mp", None),
+            P("dp", "mp", None),
+            P("dp", None),
+            P("dp", "mp", None),
+            P("dp", "mp", None),
+        ),
+        out_specs=(P("dp", "mp", None), P("dp")),
+        check_vma=False,
+    )
+    return diff(clock_b, add_b, rm_b, clock_n, add_n, rm_n)
+
+
 # One compiled step pair per mesh, same bounded-LRU discipline (and the
 # same pinning rationale) as _STREAM_STEP_CACHE below: the serve layer
 # calls these per bucket, and shape variation is already quantized by
@@ -526,6 +573,27 @@ def tenant_fold_steps(mesh: Mesh):
     while len(_TENANT_STEP_CACHE) > _TENANT_STEP_CACHE_MAX:
         _TENANT_STEP_CACHE.pop(next(iter(_TENANT_STEP_CACHE)))
     return steps
+
+
+def tenant_diff_step(mesh: Mesh):
+    """The jitted plane-diff step for one mesh — same bounded-LRU cache
+    and bucket-class pinning as :func:`tenant_fold_steps` (the two share
+    the dict; diff entries key on ``(mesh, "diff")``)."""
+    key = (mesh, "diff")
+    step = _TENANT_STEP_CACHE.pop(key, None)
+    if step is None:
+
+        @jax.jit
+        def diff_step(clock_b, add_b, rm_b, clock_n, add_n, rm_n):
+            return tenant_plane_diff_sharded(
+                mesh, clock_b, add_b, rm_b, clock_n, add_n, rm_n
+            )
+
+        step = diff_step
+    _TENANT_STEP_CACHE[key] = step
+    while len(_TENANT_STEP_CACHE) > _TENANT_STEP_CACHE_MAX:
+        _TENANT_STEP_CACHE.pop(next(iter(_TENANT_STEP_CACHE)))
+    return step
 
 
 # ---- counters -------------------------------------------------------------

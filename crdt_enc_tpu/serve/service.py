@@ -94,6 +94,13 @@ class ServeConfig:
     # seal a snapshot for tenants with no new ops (solo-compact parity);
     # off = a quiet tenant costs nothing per cycle
     seal_empty: bool = True
+    # skip the whole seal/GC/checkpoint tail for a tenant whose seal
+    # SIGNATURE has not moved since its last seal (cursor, read sets,
+    # mutation epoch — Core._seal_signature): re-sealing would publish
+    # the identical snapshot, so the cycle honestly no-ops it
+    # (``serve_noop_cycles``).  Off = every cycle re-seals, the
+    # O(state) steady state (the bench's comparison arm).
+    noop_skip: bool = True
 
 
 @dataclass
@@ -126,6 +133,7 @@ class _TenantWork:
     prepared: tuple | None = None  # fold-phase planes/vocabs
     packed: tuple | None = None  # planes-packed checkpoint payload
     state_obj: tuple | None = None  # pre-built snapshot state obj
+    delta_cut: dict | None = None  # device-cut delta candidate
     result: TenantResult = field(default_factory=TenantResult)
 
     @property
@@ -330,6 +338,7 @@ class FoldService:
             self._fold_batched(works)
             await self._fold_fallbacks(works)
             await self._seal_all(works, t0)
+            self._stamp_continuations(works)
         trace.add("serve_cycles", 1)
         trace.add("serve_tenants", len(works))
         results = [w.result for w in works]
@@ -723,9 +732,23 @@ class FoldService:
         actor = np.full((T, N_b), R_b, np.int32)  # dummy lanes: all-pad
         counter = np.zeros((T, N_b), np.int32)
         clock_rows, add_rows, rm_rows = [], [], []
+        # slots whose pre-fold planes ARE the tenant's current delta
+        # base (a live warm entry stamped with the base's seal name):
+        # after the fold these tenants can cut their delta on device
+        # from planes already in hand — no host dict walk, no retained
+        # base bytes (docs/delta.md "device-cut deltas")
+        cut_slots: list[tuple[int, object]] = []
         for slot, key in enumerate(bucket.tenants):
             w = by_idx[key]
             k, m, a, c, members, replicas, entry = w.prepared
+            if (
+                entry is not None
+                and entry.seal_name is not None
+                and entry.seal_name == w.core.delta_base_name
+                and w.core._delta_enabled
+                and getattr(w.core.storage, "has_deltas", False)
+            ):
+                cut_slots.append((slot, key))
             n = len(k)
             kind[slot, :n] = k
             member[slot, :n] = m
@@ -765,6 +788,12 @@ class FoldService:
             )
             + kind.nbytes + member.nbytes + actor.nbytes + counter.nbytes,
         )
+        # stack the pre-fold planes ONCE: the fold consumes them and —
+        # when any slot is cut-eligible — the plane diff reuses the very
+        # same device stacks as its base side
+        clock_s = jnp.stack(clock_rows)
+        add_s = jnp.stack(add_rows)
+        rm_s = jnp.stack(rm_rows)
         if self._mesh_active:
             # SPMD mega-fold: tenant lanes over dp, member planes over
             # mp (parallel.mesh.orset_fold_tenants_sharded) — slot and
@@ -774,16 +803,14 @@ class FoldService:
             orset_step, _ = pmesh.tenant_fold_steps(self.mesh)
             with trace.span("serve.shard", meta=bi):
                 out = orset_step(
-                    jnp.stack(clock_rows), jnp.stack(add_rows),
-                    jnp.stack(rm_rows), kind, member, actor, counter,
+                    clock_s, add_s, rm_s, kind, member, actor, counter,
                 )
             trace.add("serve_sharded_folds", 1)
             trace.add("serve_sharded_tenants", len(bucket.tenants))
         else:
             with trace.span("serve.fold", meta=bi):
                 out = K.orset_fold_tenants(
-                    jnp.stack(clock_rows), jnp.stack(add_rows),
-                    jnp.stack(rm_rows), kind, member, actor, counter,
+                    clock_s, add_s, rm_s, kind, member, actor, counter,
                     num_members=E_b, num_replicas=R_b,
                 )
         with trace.span("serve.scatter", meta=bi):
@@ -860,6 +887,68 @@ class FoldService:
                         state, members, replicas, planes,
                         canon=entry.canon if entry is not None else None,
                     )
+        if cut_slots:
+            # device-cut delta sealing (docs/delta.md): diff the bucket's
+            # pre-fold stacks (for eligible slots, byte-identical to the
+            # tenants' sealed diff bases) against the post-fold planes in
+            # ONE dispatch, then D2H only the diff rows per eligible
+            # tenant and build the Orswot wire form from them.  Slots
+            # that are not cut-eligible ride the same dispatch for free
+            # and their code rows are simply never read.  A separate
+            # span, deliberately outside serve.scatter: attribution
+            # groups both under the seal stage without double-counting.
+            from ..delta.codec import orset_delta_from_rows
+
+            with trace.span("delta.cut", meta=bi):
+                if self._mesh_active:
+                    from ..parallel import mesh as pmesh
+
+                    code, counts = pmesh.tenant_diff_step(self.mesh)(
+                        clock_s, add_s, rm_s, out[0], out[1], out[2]
+                    )
+                else:
+                    code, counts = K.orset_plane_diff_tenants(
+                        clock_s, add_s, rm_s, out[0], out[1], out[2]
+                    )
+                counts = np.asarray(counts)  # one (T,) D2H per bucket
+                cells = E_b * R_b
+                for slot, key in cut_slots:
+                    w = by_idx[key]
+                    _, _, _, _, members, replicas, entry = w.prepared
+                    state = w.core._data.state
+                    n_diff = int(counts[slot])
+                    if n_diff:
+                        size = min(_bucket(n_diff), cells)
+                        rows = K.orset_plane_diff_rows(
+                            code[slot], add_s[slot], out[1][slot],
+                            out[2][slot], size=size,
+                        )
+                        # the ONLY per-tenant D2H of the cut: O(diff
+                        # rows), not O(state)
+                        rows = tuple(np.asarray(r) for r in rows)
+                    else:
+                        empty = np.zeros(0, np.int64)
+                        rows = (empty, empty, empty, empty, empty)
+                    dobj = orset_delta_from_rows(
+                        rows,
+                        members=members.items,
+                        replicas=replicas.items,
+                        row_width=R_b,
+                        base_clock=np.asarray(clock_rows[slot]),
+                        new_clock=clock_all[slot],
+                    )
+                    # epoch-guarded candidate: _plan_delta_seal only
+                    # accepts it while the base name AND the mutation
+                    # epoch still match at seal time
+                    w.delta_cut = {
+                        "dobj": dobj,
+                        "base_name": entry.seal_name,
+                        "mut": state._mut,
+                        "base_planes": (
+                            clock_rows[slot], add_rows[slot],
+                            rm_rows[slot], members, replicas,
+                        ),
+                    }
 
     def _fold_gcounter_bucket(self, bi: int, bucket, by_idx) -> None:
         N_b = _bucket(bucket.rows)
@@ -971,6 +1060,31 @@ class FoldService:
                 w.result.error = repr(e)
                 w.result.path = "error"
 
+    def _stamp_continuations(self, works) -> None:
+        """Post-seal half of the persistent fold continuation: for every
+        tenant that sealed this cycle and whose warm planes still match
+        its live state, stamp the entry with the sealed snapshot's name
+        (= the tenant's new delta base).  Next cycle those planes serve
+        double duty — fold base for the tenant's new rows AND diff base
+        for the device-cut delta — so the steady-state cycle touches
+        only the tail.  Any doubt (mutation since the fold, no delta
+        base, fallback-path seal) just leaves the entry unstamped: the
+        next seal walks the host path, byte-identically."""
+        if self.warm is None:
+            return
+        with trace.span("serve.continue"):
+            stamped = 0
+            for w in works:
+                if not (w.ok and w.result.sealed):
+                    continue
+                name = w.core.delta_base_name
+                if name is None:
+                    continue
+                if self.warm.stamp_seal(w.core._data.state, name):
+                    stamped += 1
+            if stamped:
+                trace.add("serve_continuations", stamped)
+
     # -------------------------------------------------------------- seal
     async def _seal_all(self, works, t0: float) -> None:
         sem = asyncio.Semaphore(max(1, self.config.io_width))
@@ -979,6 +1093,21 @@ class FoldService:
             async with sem:
                 if not w.ok:
                     trace.add("serve_tenant_errors", 1)
+                    w.result.latency_s = time.perf_counter() - t0
+                    return
+                if (
+                    w.result.path == "empty"
+                    and self.config.noop_skip
+                    and w.core._last_seal_sig is not None
+                    and w.core._seal_signature() == w.core._last_seal_sig
+                ):
+                    # quiet tenant, nothing moved since its last seal
+                    # (cursor, read sets, mutation epoch all equal):
+                    # re-sealing would publish the identical snapshot.
+                    # Skip the seal, GC, checkpoint AND replication
+                    # sample — the honest O(tail) no-op
+                    # (docs/multitenant.md "cycle-cost law")
+                    trace.add("serve_noop_cycles", 1)
                     w.result.latency_s = time.perf_counter() - t0
                     return
                 if w.result.path == "empty" and not self.config.seal_empty:
@@ -993,6 +1122,7 @@ class FoldService:
                         await w.core._compact_seal(
                             _backlog=[], _packed_state=w.packed,
                             _state_obj=w.state_obj,
+                            _delta_cut=w.delta_cut,
                         )
                     w.result.sealed = True
                 except Exception as e:

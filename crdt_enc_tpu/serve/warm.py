@@ -42,7 +42,7 @@ class WarmEntry:
     """One tenant's cached fold planes (see module docs)."""
 
     __slots__ = ("ref", "token", "members", "replicas", "planes", "canon",
-                 "nbytes")
+                 "nbytes", "seal_name")
 
     def __init__(self, ref, token, members, replicas, planes, canon):
         self.ref = ref
@@ -52,6 +52,13 @@ class WarmEntry:
         self.planes = planes  # (clock, add, rm) arrays, padded shapes
         self.canon = canon  # member slot -> canonical packed bytes
         self.nbytes = sum(int(getattr(p, "nbytes", 0)) for p in planes)
+        # content-addressed name of the sealed snapshot these planes ARE
+        # (stamped after a successful seal by PlaneWarmTier.stamp_seal);
+        # None until then.  When it matches the core's delta-base name,
+        # the next cycle can cut the tenant's delta on device from these
+        # planes and the core need not retain the host-resident base
+        # bytes at all (docs/delta.md "device-cut deltas").
+        self.seal_name = None
 
 
 class PlaneWarmTier:
@@ -107,6 +114,11 @@ class PlaneWarmTier:
         ):
             self._drop(key)
             trace.add("serve_warm_misses", 1)
+            # refine the reason: an entry EXISTED but the state mutated
+            # under it (or the id was recycled) — the mut-epoch expiry
+            # the continuation fallback tests count, vs. a plain
+            # never-stored / LRU-evicted miss
+            trace.add("serve_warm_expired", 1)
             return None
         self._entries.move_to_end(key)
         trace.add("serve_warm_hits", 1)
@@ -144,3 +156,20 @@ class PlaneWarmTier:
             trace.add("serve_warm_evictions", 1)
         trace.gauge("serve_warm_bytes", self._bytes)
         return entry
+
+    def stamp_seal(self, state, seal_name) -> bool:
+        """Mark ``state``'s live warm entry as byte-identical to the
+        sealed snapshot ``seal_name`` — called by the service AFTER a
+        successful seal, iff the state has not mutated since the planes
+        were stored.  Deliberately not a :meth:`lookup` (no hit/miss
+        accounting, no LRU refresh): this is a seal-time annotation, not
+        a use.  Returns False (and stamps nothing) on any doubt."""
+        entry = self._entries.get(id(state))
+        if (
+            entry is None
+            or entry.ref() is not state
+            or entry.token != getattr(state, "_mut", None)
+        ):
+            return False
+        entry.seal_name = seal_name
+        return True

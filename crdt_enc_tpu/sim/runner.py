@@ -803,35 +803,54 @@ class SimRunner:
         return None
 
     async def _check_warm_cold(self, reference: bytes, step_idx: int):
+        """Warm-open vs cold-open byte identity for the sampled
+        replicas.  The per-replica (warm → cold) pair fans out ACROSS
+        replicas in one gather (the sim fast path's second slice —
+        the same argument as the drain loop's: each replica's own
+        storage-call stream keeps its order inside its coroutine, and
+        the fault-roll RNG streams are per-storage, so cross-replica
+        interleaving cannot move a single tally); the violation scan
+        stays serial in replica order, so the FIRST violation reported
+        is deterministic."""
         from ..models import canonical_bytes
 
-        checked = 0
-        for rep in self.replicas:
-            if checked >= WARM_COLD_SAMPLES:
-                break
-            checked += 1
+        async def one(rep):
             warm = await Core.open(self._opts(rep, create=False))
             await warm.read_remote()
             cold = await Core.open(
                 self._opts(rep, create=False, checkpoint=False)
             )
             await cold.read_remote()
-            wb = warm.with_state(canonical_bytes)
-            cb = cold.with_state(canonical_bytes)
+            return (
+                warm.with_state(canonical_bytes),
+                cold.with_state(canonical_bytes),
+                warm.checkpoint_fallback_reason,
+            )
+
+        sampled = self.replicas[:WARM_COLD_SAMPLES]
+        results = await asyncio.gather(*(one(rep) for rep in sampled))
+        for rep, (wb, cb, fallback) in zip(sampled, results):
             if wb != cb or wb != reference:
                 return Violation(
                     "warm_cold",
                     f"r{rep.idx}: warm-open {'==' if wb == cb else '!='} "
                     f"cold-open, fleet match warm={wb == reference} "
                     f"cold={cb == reference} "
-                    f"(fallback={warm.checkpoint_fallback_reason})",
+                    f"(fallback={fallback})",
                     step_idx,
                 )
         return None
 
     async def _check_monotonicity(self, step_idx: int):
-        for rep in self.replicas:
-            status = await rep.core.replication_status()
+        """Replication-status sampling fans out across replicas in one
+        gather (same per-replica stream argument as above); the
+        regression comparison and the ``last_status`` update run
+        serially in replica order afterwards, so both the violation
+        choice and the stored baselines are deterministic."""
+        statuses = await asyncio.gather(
+            *(rep.core.replication_status() for rep in self.replicas)
+        )
+        for rep, status in zip(self.replicas, statuses):
             defect = replication_regression(rep.last_status, status)
             if defect is not None:
                 return Violation(

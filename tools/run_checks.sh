@@ -49,6 +49,15 @@ echo "== daemon-enabled sim smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
     --seed 0 --replicas 4 --steps 80 --faults all --daemon
 
+echo "== combined sim smoke: daemon + deltas + strong reads (bounded) =="
+# the ISSUE-16 acceptance envelope: continuation-enabled serve cycles,
+# delta-state replication, and linearizable reads all inside ONE
+# all-fault schedule — the vocabularies compose, and the quiescence
+# invariants check the combination
+JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
+    --seed 0 --replicas 4 --steps 80 --faults all \
+    --deltas --strong-reads --daemon
+
 echo "== daemon smoke: faulted cycles -> drain -> fsck =="
 # bounded always-on daemon selftest: an in-memory fleet with injected
 # tenant faults runs supervised cycles (errors must isolate into
@@ -73,6 +82,15 @@ echo "== delta-vs-snapshot differential gate =="
 # and both storage backends (docs/delta.md)
 JAX_PLATFORMS=cpu python -m pytest tests/test_delta.py -q \
     -p no:cacheprovider -k "differential or rides_device_kernels"
+
+echo "== idle-cycle gate (O(tail) steady state) =="
+# a quiet tenant's steady-state cycle must be an honest no-op: zero
+# XLA compiles, zero state H2D, zero storage probes beyond the listing
+# (spy-pinned), and the committed --e2e-idle-cycle record must hold the
+# >=10x bar at 1% active (docs/multitenant.md "The cycle-cost law")
+JAX_PLATFORMS=cpu python -m pytest tests/test_continuation.py -q \
+    -p no:cacheprovider \
+    -k "quiet_steady_state or idle_cycle_metric or device_cut_cycle"
 
 echo "== obs_report fleet golden =="
 # the SLO column follows the active CRDT_SLO_* config by design — pin
