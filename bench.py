@@ -2446,7 +2446,16 @@ def bench_sim(smoke: bool):
     without the TPU gate.
 
     Flags/envs: ``--replicas N`` (8), ``--steps M`` (250), ``--faults
-    all|none|cls,cls`` (all), BENCH_SIM_SEEDS (4)."""
+    all|none|cls,cls`` (all), ``--population P`` (run P schedules
+    concurrently through one shared substrate, sim/population.py — the
+    record's config gains a ``_pP`` suffix so the serial baseline stays
+    a separate trend series), BENCH_SIM_SEEDS (4 serial; 2·P
+    population).
+
+    Population refusal guard: after the clock stops, every schedule is
+    re-run SERIALLY and its fingerprint compared — any divergence
+    refuses the record (a population throughput that changed the
+    results measured nothing)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import logging
 
@@ -2456,6 +2465,7 @@ def bench_sim(smoke: bool):
 
     replicas = _flag_int("--replicas", 4 if smoke else 8)
     steps = _flag_int("--steps", 50 if smoke else 250)
+    population = _flag_int("--population", 0)
     spec = "all"
     if "--faults" in sys.argv:
         i = sys.argv.index("--faults")
@@ -2463,19 +2473,37 @@ def bench_sim(smoke: bool):
             raise SystemExit("--faults wants all|none|class,class")
         spec = sys.argv[i + 1]
     faults = _build_faults(spec)
-    n_seeds = int(os.environ.get("BENCH_SIM_SEEDS", 2 if smoke else 4))
+    n_seeds = int(os.environ.get(
+        "BENCH_SIM_SEEDS",
+        (2 if smoke else 4) if population < 2 else 2 * population,
+    ))
 
     from collections import Counter
 
     totals: Counter = Counter()
     total_steps = total_checks = quarantined = 0
+    report = None
     t0 = time.perf_counter()
-    for seed in range(n_seeds):
-        schedule = generate(seed, replicas, steps, faults)
-        result = run_schedule(schedule)
+    if population > 1:
+        from crdt_enc_tpu.sim import run_population
+
+        schedules = [
+            generate(seed, replicas, steps, faults)
+            for seed in range(n_seeds)
+        ]
+        report = run_population(schedules, population=population)
+        results = list(zip(schedules, report.results))
+    else:
+        results = []
+        for seed in range(n_seeds):
+            schedule = generate(seed, replicas, steps, faults)
+            results.append((schedule, run_schedule(schedule)))
+    wall = time.perf_counter() - t0
+    for schedule, result in results:
         if not result.ok:
             raise SystemExit(
-                f"sim seed {seed} violated an invariant: {result.violation}"
+                f"sim seed {schedule.seed} violated an invariant: "
+                f"{result.violation}"
                 " — fix the bug (and commit the shrunk fixture); a broken"
                 " protocol has no throughput to record"
             )
@@ -2483,10 +2511,21 @@ def bench_sim(smoke: bool):
         total_steps += result.steps_run
         total_checks += result.checks_run
         quarantined += result.quarantined
-    wall = time.perf_counter() - t0
+    if report is not None:
+        # the serial-equivalence refusal guard (untimed: the record is
+        # the population wall, the guard is the evidence behind it)
+        from crdt_enc_tpu.sim import verify_serial_equality
+
+        problems = verify_serial_equality(report)
+        if problems:
+            raise SystemExit(
+                "population run diverged from its serial twins — "
+                "refusing to record:\n  " + "\n  ".join(problems)
+            )
+    suffix = f"_p{population}" if population > 1 else ""
     result_rec = {
         "metric": "sim_schedules_per_sec",
-        "config": f"sim_{replicas}r_{steps}s_{spec}",
+        "config": f"sim_{replicas}r_{steps}s_{spec}{suffix}",
         "value": round(n_seeds / wall, 3),
         "unit": "schedules/s",
         "steps_per_sec": round(total_steps / wall, 1),
@@ -2502,9 +2541,14 @@ def bench_sim(smoke: bool):
         "wall_s": round(wall, 3),
         "backend": "cpu",
     }
+    if population > 1:
+        result_rec["population"] = population
+        result_rec["serial_equivalent"] = True
     log(
         f"sim: {n_seeds} schedules ({replicas} replicas x {steps} steps, "
-        f"faults={spec}) in {wall:.2f}s = {result_rec['value']} sched/s, "
+        f"faults={spec}"
+        + (f", population={population}" if population > 1 else "")
+        + f") in {wall:.2f}s = {result_rec['value']} sched/s, "
         f"{result_rec['faults_survived_total']} faults survived"
     )
     print(json.dumps(result_rec))

@@ -2480,6 +2480,7 @@ class Core:
         ]
         states_to_remove = sorted(d.read_states)
         ops_to_remove = sorted(d.next_op_versions.counters.items())
+        prior_names = frozenset(d.read_states)
         # consumed-prefix GC covers FOREIGN logs only: the own log is
         # governed by _seal_delta's MAX_CHAIN bound — a stale reopen
         # that re-scanned its own chain must not wipe links steady
@@ -2497,6 +2498,27 @@ class Core:
             # crash between the two leaves a snapshot consumers simply
             # full-read) and BEFORE the GC below
             await self._seal_delta(delta_plan, name)
+        # snapshot-GC guard: foreign snapshots may only be removed when
+        # the justifying snapshot ``name`` has never been published
+        # before.  A re-seal of unchanged state reproduces its previous
+        # content-addressed name — a name concurrent peers may already
+        # have read, making it a legal target of THEIR GC; when every
+        # member of a batch re-seals unchanged state, the union of
+        # removes can wipe every snapshot (each remove justified by a
+        # snapshot that is itself another sealer's remove target), and a
+        # crashed replica reopening cold finds an empty remote it can
+        # never converge from.  A never-before-published name cannot be
+        # a concurrent remove target (removing requires having read it,
+        # which orders the remover strictly after this store), so its
+        # removes always stay covered by a durable snapshot — the GC
+        # ordering _ensure_own_history's cross-check assumes.  Deferred
+        # names stay in read_states and are GC'd by the next
+        # genuinely-new seal.
+        if name in prior_names:
+            stale_states: list[str] = []
+            trace.add("seal_gc_deferred", 1)
+        else:
+            stale_states = states_to_remove
         with trace.span("compact.gc"):
             if deltas_to_remove and self._delta_enabled:
                 # consumed delta prefixes go FIRST: the new snapshot
@@ -2505,13 +2527,11 @@ class Core:
                 # chain heads (docs/delta.md GC ordering)
                 await self.storage.remove_deltas(deltas_to_remove)
             await asyncio.gather(
-                self.storage.remove_states(
-                    [n for n in states_to_remove if n != name]
-                ),
+                self.storage.remove_states(stale_states),
                 self.storage.remove_ops(ops_to_remove),
             )
         # sync bookkeeping section
-        d.read_states.difference_update(states_to_remove)
+        d.read_states.difference_update(stale_states)
         d.read_states.add(name)
         # record what this seal depended on, AT the snapshot epoch: the
         # serving layer skips the next seal iff the signature has not

@@ -111,6 +111,14 @@ def attribute_cycle(
     chunk-level overlap proof."""
     with record.span("attribution.gap"):
         spans = snapshot.get("spans", {})
+        # simulator harness spans (sim.run / sim.step / sim.check /
+        # sim.population) WRAP the serve spans a sim service cycle
+        # records — they are schedule bookkeeping, not cycle stages.
+        # Drop them explicitly: left in, they would dominate the
+        # event-extent wall inference and report a whole simulation as
+        # one impossibly slow cycle.
+        spans = {n: v for n, v in spans.items()
+                 if not n.startswith("sim.")}
         pipe = pipeline or detect_pipeline(snapshot)
         stage_map = _SERVE_STAGES if pipe == "serve" else _STREAM_STAGES
 
@@ -123,7 +131,8 @@ def attribute_cycle(
 
         if wall_s is None and events:
             span_events = [e for e in events
-                           if e.get("kind", "span") == "span"]
+                           if e.get("kind", "span") == "span"
+                           and not str(e.get("name", "")).startswith("sim.")]
             if span_events:
                 wall_s = (max(e["t1"] for e in span_events)
                           - min(e["t0"] for e in span_events))

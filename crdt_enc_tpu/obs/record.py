@@ -55,6 +55,7 @@ Span and metric names are REGISTERED in ``docs/observability.md``;
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import math
 import sys
@@ -239,11 +240,40 @@ def observe(name: str, seconds: float, meta=None) -> None:
 
 
 # ---------------------------------------------------------------- counters
+# Context-local counter taps: the registry's counters are process-wide,
+# which is exactly wrong for a caller that needs "increments caused by MY
+# work" while other tasks share the process (the population runner's
+# lanes each need their own quarantine tally).  A tap is a plain dict
+# registered in the calling context; every add() mirrors its increment
+# into each tap visible from the caller's context.  asyncio tasks and
+# to_thread hops copy the context at creation, so a tap covers the whole
+# task tree under the ``with`` — and nothing outside it.
+_taps: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "crdt_trace_counter_taps", default=()
+)
+
+
+@contextmanager
+def counter_tap():
+    """Yield a dict accumulating every counter increment made from this
+    context (and tasks/threads spawned within it) until exit.  Taps
+    nest — an inner tap does not steal from an outer one, both see the
+    increment.  The global registry is untouched; read the tap."""
+    local: dict[str, int] = {}
+    token = _taps.set(_taps.get() + (local,))
+    try:
+        yield local
+    finally:
+        _taps.reset(token)
+
+
 def add(name: str, n: int = 1) -> None:
     """Bump a counter (e.g. ops folded, states merged, bytes decrypted)."""
     with _lock:
         value = _counters.get(name, 0) + n
         _counters[name] = value
+        for tap in _taps.get():
+            tap[name] = tap.get(name, 0) + n
         if _events_enabled:
             e = _event_base(name, "counter")
             e["t0"] = e["t1"] = time.perf_counter()

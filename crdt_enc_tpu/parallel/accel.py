@@ -82,9 +82,22 @@ class TpuAccelerator(HostAccelerator):
         sharded_stream: bool | None = None,
         stream_producers: int = 0,
         plane_reuse: bool | None = None,
+        bucket_vocab: bool | None = None,
     ):
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # vocabulary-axis bucketing (None = env CRDT_BUCKET_VOCAB, default
+        # OFF): lift the member/replica plane dims — and merge stack
+        # heights — to power-of-two classes (zero padding; sliced back at
+        # writeback).  Row counts are always bucketed; this extends the
+        # same recompilation bound to E/R/S, so many small states with
+        # churning vocabularies (the simulator's population shape) share
+        # one compiled program set instead of compiling per vocab size.
+        if bucket_vocab is None:
+            bucket_vocab = os.environ.get(
+                "CRDT_BUCKET_VOCAB", ""
+            ).strip().lower() in ("1", "true", "on", "yes", "enabled")
+        self.bucket_vocab = bool(bucket_vocab)
         # device-resident plane reuse across fold rounds (None = auto-on;
         # CRDT_PLANE_REUSE=0 opts out).  Single-device only: the sharded
         # fold keeps planes mp-distributed and re-builds per round.
@@ -325,6 +338,18 @@ class TpuAccelerator(HostAccelerator):
         E, R = len(members), len(replicas)
         if E == 0 or R == 0:
             return state
+        # vocab-axis compile classes (bucket_vocab): fold at the padded
+        # (Ep, Rp) and slice back at writeback.  Zero rows/columns are
+        # inert through the whole kernel — no op references a padded
+        # member, padded replica columns carry zero clocks and zero
+        # cells, and the sentinel row mask keys on ``actor >= Rp``.
+        bucketed = (
+            self.bucket_vocab
+            and not self._mesh_active()
+            and n_rows <= self.STREAM_CHUNK_ROWS
+        )
+        Ep = _bucket(E) if bucketed else E
+        Rp = _bucket(R) if bucketed else R
         if self._mesh_active():
             # SPMD fold: rows shard over dp, planes over mp.  The mp axis is
             # also what makes huge (E, R) planes tractable — each device
@@ -352,13 +377,21 @@ class TpuAccelerator(HostAccelerator):
             if c is not None and c.ref() is state:
                 self._plane_cache = None  # sparse writeback: planes stale
             return folded
+        if self.bucket_vocab and not bucketed:
+            # the streaming fold runs at true (E, R); cached planes from a
+            # bucketed round may be padded past it, so rebuild from state
+            cache = None
         if cache is not None:
-            clock0, add0, rm0 = self._cached_planes_padded(cache, E, R)
+            clock0, add0, rm0 = self._cached_planes_padded(cache, Ep, Rp)
         else:
             with trace.span("fold.planes"):
                 clock0, add0, rm0 = K.orset_state_to_planes(
                     state, members, replicas, scanned=True
                 )
+            if (Ep, Rp) != (E, R):
+                clock0 = np.pad(clock0, (0, Rp - R))
+                add0 = np.pad(add0, ((0, Ep - E), (0, Rp - R)))
+                rm0 = np.pad(rm0, ((0, Ep - E), (0, Rp - R)))
         with trace.span("fold.device"):
             if n_rows > self.STREAM_CHUNK_ROWS:
                 if cache is not None:
@@ -403,8 +436,8 @@ class TpuAccelerator(HostAccelerator):
                         clock0.nbytes + add0.nbytes + rm0.nbytes,
                     )
                 cols = K.OrsetColumns(kind, member, actor, counter, members, replicas)
-                K.pad_orset_rows(cols, _bucket(len(cols.kind)), R)
-                fold = self._pick_dense_fold(cols, E, R)
+                K.pad_orset_rows(cols, _bucket(len(cols.kind)), Rp)
+                fold = self._pick_dense_fold(cols, Ep, Rp)
                 dev_planes = fold(
                     clock0,
                     add0,
@@ -415,6 +448,8 @@ class TpuAccelerator(HostAccelerator):
                     cols.counter,
                 )
             clock, add, rm = (np.asarray(x) for x in dev_planes)
+            if (Ep, Rp) != (E, R):
+                clock, add, rm = clock[:R], add[:E, :R], rm[:E, :R]
         obs_runtime.sample_device_memory()  # fold boundary
         with trace.span("fold.writeback"):
             folded = K.orset_planes_to_state(clock, add, rm, members, replicas)
@@ -1345,10 +1380,24 @@ class TpuAccelerator(HostAccelerator):
         clocks = np.stack([p[0] for p in planes])
         adds = np.stack([p[1] for p in planes])
         rms = np.stack([p[2] for p in planes])
+        E, R = len(members), len(replicas)
+        if self.bucket_vocab:
+            # merge at power-of-two (S, E, R) classes: all-zero states are
+            # the merge identity and zero vocab lanes are inert, so the
+            # padded tree merge is byte-equal after the slice back — and a
+            # population of small states shares one compiled merge set
+            S = len(all_states)
+            Sp, Ep, Rp = _bucket(S, 2), _bucket(E), _bucket(R)
+            if (Sp, Ep, Rp) != (S, E, R):
+                pad = ((0, Sp - S), (0, Ep - E), (0, Rp - R))
+                clocks = np.pad(clocks, (pad[0], pad[2]))
+                adds = np.pad(adds, pad)
+                rms = np.pad(rms, pad)
         clock, add, rm = K.orset_merge_many(clocks, adds, rms)
-        merged = K.orset_planes_to_state(
-            np.asarray(clock), np.asarray(add), np.asarray(rm), members, replicas
-        )
+        clock = np.asarray(clock)[:R]
+        add = np.asarray(add)[:E, :R]
+        rm = np.asarray(rm)[:E, :R]
+        merged = K.orset_planes_to_state(clock, add, rm, members, replicas)
         state.clock = merged.clock
         state.entries = merged.entries
         state.deferred = merged.deferred

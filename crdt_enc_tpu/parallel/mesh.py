@@ -78,6 +78,26 @@ def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
     return Mesh(arr, axis_names=("dp", "mp"))
 
 
+def mesh_for_population(n_lanes: int, devices=None) -> Mesh | None:
+    """The population runner's mesh (sim/population.py): schedule×tenant
+    lanes ride the ``dp`` axis — a lane's tenants are just more rows in
+    the PR-14 tenant mega-fold, since schedules never interact — and the
+    replica planes ride ``mp``.  dp gets the device majority (lanes
+    outnumber the per-tenant replica-plane width in every population
+    shape), mp takes what cleanly remains: dp = min(n_lanes, D) and
+    mp = D // dp when that divides, else a flat (D, 1).  Returns None on
+    a single-device host — the unsharded path IS the single-chip layout,
+    and a size-1 mesh must not pretend otherwise (parse_mesh_spec
+    enforces the same rule for explicit specs)."""
+    devices = devices if devices is not None else jax.devices()
+    n_dev = len(devices)
+    if n_dev < 2:
+        return None
+    dp = max(1, min(int(n_lanes), n_dev))
+    mp = n_dev // dp if n_dev % dp == 0 else 1
+    return make_mesh((dp, mp), devices=devices[: dp * mp])
+
+
 def _local_fold(clock0, add0, rm0, kind, member, actor, counter, member_lo, R,
                 impl="xla", tile_cap=0, interpret=False, retire_rm=True):
     """Per-device body: fold this device's op rows into its member slice.

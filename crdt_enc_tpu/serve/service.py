@@ -281,6 +281,11 @@ class FoldService:
         # error — never a hang or an interleaved fold
         self._closed = False
         self._cycle_running = False
+        # shared-owner serialization (run_cycle_shared): lazily built per
+        # event loop so a service outliving one asyncio.run() can be
+        # shared again under the next loop
+        self._owner_lock: asyncio.Lock | None = None
+        self._owner_loop = None
 
     @property
     def closed(self) -> bool:
@@ -325,6 +330,27 @@ class FoldService:
             )
         finally:
             self._cycle_running = False
+
+    async def run_cycle_shared(self, tenants=None) -> list[TenantResult]:
+        """Subset-cycle entry for MULTIPLE concurrent owners sharing one
+        service (the population runner's lanes, docs/simulation.md
+        "Population runs"): overlapping calls QUEUE on an internal
+        asyncio lock and run one full cycle at a time, instead of
+        tripping :meth:`run_cycle`'s non-reentrancy error.  Each queued
+        cycle is exactly the cycle its owner would have run on a private
+        service — the fold phase still has exclusive ownership of its
+        tenants for the duration, the shared warm tier is keyed by
+        tenant-state identity so owners never alias — which is what
+        keeps a lane's results bit-identical to its serial twin while P
+        lanes amortize one set of jitted programs.  Single-owner callers
+        should keep using :meth:`run_cycle`: the loud overlap error
+        there is a real bug-catcher, not a nuisance."""
+        loop = asyncio.get_running_loop()
+        if self._owner_lock is None or self._owner_loop is not loop:
+            self._owner_lock = asyncio.Lock()
+            self._owner_loop = loop
+        async with self._owner_lock:
+            return await self.run_cycle(tenants)
 
     async def _run_cycle(self, tenants) -> list[TenantResult]:
         t0 = time.perf_counter()

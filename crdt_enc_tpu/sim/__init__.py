@@ -13,16 +13,27 @@ CLI over the same calls.
 """
 
 from .check import InvariantViolation, Violation
+from .coverage import CoFireMatrix
 from .faults import FaultConfig, FaultyStorage, SimCrash
+from .population import (
+    PopulationReport,
+    PopulationSubstrate,
+    run_budget,
+    run_population,
+    verify_serial_equality,
+)
 from .runner import DeterministicCryptor, SimResult, SimRunner, run_schedule
 from .schedule import STEP_KINDS, Schedule, Step, generate
 from .shrink import shrink, to_fixture
 
 __all__ = [
+    "CoFireMatrix",
     "FaultConfig",
     "FaultyStorage",
     "InvariantViolation",
     "DeterministicCryptor",
+    "PopulationReport",
+    "PopulationSubstrate",
     "STEP_KINDS",
     "Schedule",
     "SimCrash",
@@ -31,7 +42,10 @@ __all__ = [
     "Step",
     "Violation",
     "generate",
+    "run_budget",
+    "run_population",
     "run_schedule",
     "shrink",
     "to_fixture",
+    "verify_serial_equality",
 ]

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import contextvars
 import hashlib
 import json
 import logging
@@ -84,19 +85,50 @@ class DeterministicCryptor(IdentityCryptor):
         )
 
 
+# The per-run uuid stream lives in a ContextVar, not a bare global: a
+# population run (sim/population.py) executes P schedules concurrently in
+# one event loop, and each lane's task context — inherited by every child
+# task and to_thread hop it spawns — carries its OWN schedule-seeded
+# stream.  A serial run sees exactly the historical single stream, and
+# code outside any sim context falls through to the real uuid4.
+_UUID_RNG: contextvars.ContextVar = contextvars.ContextVar(
+    "crdt_sim_uuid_rng", default=None
+)
+_uuid_orig = None
+_uuid_patches = 0
+
+
+def _context_uuid4():
+    rng = _UUID_RNG.get()
+    if rng is None:
+        return _uuid_orig()
+    return uuid.UUID(int=rng.getrandbits(128), version=4)
+
+
 @contextlib.contextmanager
 def _deterministic_uuid(seed: int):
-    """Patch ``uuid.uuid4`` to a schedule-seeded stream for the run:
+    """Route ``uuid.uuid4`` to a schedule-seeded stream for the run:
     actor ids and key ids are the only remaining entropy behind file
-    names and sort orders.  Restored on exit; the simulator is a test
-    harness and runs single-threaded per process."""
+    names and sort orders.  The stream is context-local (see above);
+    the global ``uuid.uuid4`` patch is refcounted so overlapping
+    population lanes install it once and the real uuid4 is restored
+    when the last lane exits.  The event loop is single-threaded, so
+    the refcount needs no lock."""
+    global _uuid_orig, _uuid_patches
     rng = random.Random(f"crdt-sim-uuid-{seed}")
-    orig = uuid.uuid4
-    uuid.uuid4 = lambda: uuid.UUID(int=rng.getrandbits(128), version=4)
+    token = _UUID_RNG.set(rng)
+    if _uuid_patches == 0:
+        _uuid_orig = uuid.uuid4
+        uuid.uuid4 = _context_uuid4
+    _uuid_patches += 1
     try:
         yield
     finally:
-        uuid.uuid4 = orig
+        _uuid_patches -= 1
+        if _uuid_patches == 0:
+            uuid.uuid4 = _uuid_orig
+            _uuid_orig = None
+        _UUID_RNG.reset(token)
 
 
 @dataclass
@@ -172,9 +204,17 @@ class SimRunner:
     the memory backend ignores it."""
 
     def __init__(self, schedule: Schedule, *, tmpdir: str | None = None,
-                 mesh=None):
+                 mesh=None, substrate=None):
         self.schedule = schedule
         self.tmpdir = tmpdir
+        # population mode (sim/population.py): the shared substrate
+        # supplies the ONE process-wide accelerator and FoldService
+        # every lane folds through — compile classes and warm tiers are
+        # fleet-wide, while storage, fault rolls, cryptors, and the
+        # uuid stream stay strictly per-lane
+        self.substrate = substrate
+        if mesh is None and substrate is not None:
+            mesh = substrate.mesh
         self.mesh = mesh  # service/daemon cycles run mesh-backed folds
         self.replicas: list[_Replica] = []
         self.members = [
@@ -239,6 +279,12 @@ class SimRunner:
         # odd replicas fold on the accelerator, even on the host
         # reference — both execution paths face every history
         if idx % 2 == 1:
+            if self.substrate is not None:
+                # one accelerator for the whole population: its plane
+                # cache is state-identity keyed (never aliases across
+                # lanes) and its vocab bucketing lands every lane's
+                # folds in shared power-of-two compile classes
+                return {"accelerator": self.substrate.accel}
             from ..parallel import TpuAccelerator
 
             return {"accelerator": TpuAccelerator(min_device_batch=1)}
@@ -284,8 +330,15 @@ class SimRunner:
         :class:`SimResult`; protocol violations land on
         ``result.violation`` instead of raising, so the shrinker and
         the CLI share one calling convention."""
+        return asyncio.run(self.run_async())
+
+    async def run_async(self) -> SimResult:
+        """Population entry (sim/population.py): the same run, awaited
+        inside an already-running event loop so P lanes share one loop
+        and one substrate.  The uuid stream installs into THIS task's
+        context only — concurrent lanes never see each other's draws."""
         with _deterministic_uuid(self.schedule.seed):
-            return asyncio.run(self._run())
+            return await self._run()
 
     async def _run(self) -> SimResult:
         sched = self.schedule
@@ -316,29 +369,33 @@ class SimRunner:
             for rep in self.replicas:
                 rep.storage.arm()
 
-            q0 = int(trace.snapshot()["counters"].get("ingest_quarantined", 0))
-            try:
-                for step_idx, step in enumerate(sched.steps):
-                    result.steps_run = step_idx + 1
-                    trace.add("sim_steps", 1)
-                    with trace.span("sim.step", meta=step_idx):
-                        violation = await self._exec(step, step_idx)
-                    if violation is not None:
-                        result.violation = violation
-                        break
-                if result.violation is None:
-                    try:
-                        result.violation = await self._quiesce_and_check(
-                            len(sched.steps)
-                        )
-                    except InvariantViolation:
-                        raise
-                    except Exception as e:
-                        result.violation = Violation(
-                            "check_error", repr(e), len(sched.steps)
-                        )
-            except InvariantViolation as iv:
-                result.violation = iv.violation
+            # per-run quarantine tally via a context-local counter tap:
+            # the registry's ingest_quarantined is process-wide, and a
+            # population interleaves P runs' increments — the tap sees
+            # exactly the increments made by THIS run's task tree
+            with trace.counter_tap() as tap:
+                try:
+                    for step_idx, step in enumerate(sched.steps):
+                        result.steps_run = step_idx + 1
+                        trace.add("sim_steps", 1)
+                        with trace.span("sim.step", meta=step_idx):
+                            violation = await self._exec(step, step_idx)
+                        if violation is not None:
+                            result.violation = violation
+                            break
+                    if result.violation is None:
+                        try:
+                            result.violation = await self._quiesce_and_check(
+                                len(sched.steps)
+                            )
+                        except InvariantViolation:
+                            raise
+                        except Exception as e:
+                            result.violation = Violation(
+                                "check_error", repr(e), len(sched.steps)
+                            )
+                except InvariantViolation as iv:
+                    result.violation = iv.violation
         for rep in self.replicas:
             result.fault_stats.update(rep.storage.stats)
         trace.add(
@@ -352,9 +409,7 @@ class SimRunner:
         result.service_cycles = self.service_cycles
         result.daemon_cycles = self.daemon_cycles
         result.checks_run = self.checks_run
-        result.quarantined = (
-            int(trace.snapshot()["counters"].get("ingest_quarantined", 0)) - q0
-        )
+        result.quarantined = int(tap.get("ingest_quarantined", 0))
         result.fingerprint = self._fingerprint(result)
         return result
 
@@ -507,12 +562,20 @@ class SimRunner:
         if peer is not rep and peer.core is not None:
             tenants.append(peer)
         if self._service_pool is None:
-            self._service_pool = FoldService(
-                [], ServeConfig(seal_empty=True), mesh=self.mesh
-            )
-        results = await self._service_pool.run_cycle(
-            [t.core for t in tenants]
-        )
+            if self.substrate is not None:
+                self._service_pool = self.substrate.service
+            else:
+                self._service_pool = FoldService(
+                    [], ServeConfig(seal_empty=True), mesh=self.mesh
+                )
+        cores = [t.core for t in tenants]
+        if self.substrate is not None:
+            # shared-owner entry: overlapping lanes queue; each queued
+            # cycle touches only this lane's tenants, so the lane's
+            # cycle is byte-identical to the private-service cycle
+            results = await self._service_pool.run_cycle_shared(cores)
+        else:
+            results = await self._service_pool.run_cycle(cores)
         self.service_cycles += 1
         for t, res in zip(tenants, results):
             if res.error is None:

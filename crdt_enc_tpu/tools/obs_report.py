@@ -287,6 +287,22 @@ def cmd_diff(args) -> int:
     return 0
 
 
+def cmd_simcov(args) -> int:
+    from ..sim import CoFireMatrix
+
+    try:
+        matrix = CoFireMatrix.load(args.file)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"{args.file}: unreadable coverage matrix: {e!r}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(matrix.to_obj(), sort_keys=True))
+    else:
+        print(matrix.render())
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="crdt_enc_tpu.tools.obs_report",
@@ -365,6 +381,15 @@ def main(argv=None) -> int:
     p.add_argument("files", nargs="+", metavar="DEVICE.jsonl")
     p.add_argument("--json", action="store_true", help="machine output")
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "simcov",
+        help="render a fault-class × vocabulary co-fire matrix "
+        "(tools.sim explore --coverage-out)",
+    )
+    p.add_argument("file", metavar="COVERAGE.json")
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.set_defaults(fn=cmd_simcov)
 
     p = sub.add_parser(
         "trend", help="per-config perf trajectory over BENCH_LOCAL.jsonl"

@@ -4,13 +4,19 @@
         --steps 500 --faults all [--backend memory|fs] [--deltas] \
         [--daemon] [--shrink OUT.json]
     python -m crdt_enc_tpu.tools.sim explore --seeds 0:20 --replicas 4 \
-        --steps 120 --faults all
+        --steps 120 --faults all [--population P] [--budget-s N] \
+        [--coverage-out f.json] [--shrink OUT.json]
     python -m crdt_enc_tpu.tools.sim replay tests/data/sim [FILE.json ...]
 
 ``run`` executes one seeded schedule and checks every quiescence
 invariant; on failure, ``--shrink`` delta-debugs the schedule to a
 minimal reproducer and writes a replayable fixture.  ``explore`` sweeps
-a seed range.  ``replay`` runs committed fixtures (directories expand
+a seed range — ``--population P`` runs P schedules concurrently through
+one shared substrate (bit-identical results, docs/simulation.md
+"Population runs"), ``--budget-s N`` keeps the population full by
+refilling finished lanes with the next seed until the wall-clock budget
+expires, and ``--coverage-out`` dumps the fault×vocabulary co-fire
+matrix.  ``replay`` runs committed fixtures (directories expand
 to their ``*.json``) and exits non-zero if any regresses — every file
 under ``tests/data/sim/`` is a fixed bug's permanent regression test,
 and a non-fixture file in that directory is an error (nothing in the
@@ -116,19 +122,96 @@ def _cmd_explore(args) -> int:
     except ValueError:
         raise SystemExit(f"--seeds wants LO:HI, got {args.seeds!r}")
     faults = _build_faults(args.faults)
-    failures = 0
-    for seed in range(lo, hi):
-        schedule = generate(
+    if (args.population > 1 or args.budget_s) and args.backend != "memory":
+        raise SystemExit(
+            "--population/--budget-s need --backend memory: population "
+            "runs are bound by the serial-equality contract, which the "
+            "fs backend's thread-pool timing cannot honor"
+        )
+
+    def make_schedule(seed):
+        return generate(
             seed, args.replicas, args.steps, faults,
             members=args.members, backend=args.backend, deltas=args.deltas,
             daemon=args.daemon, strong_reads=args.strong_reads,
         )
-        result = _execute(schedule)
-        _report(f"seed {seed}", schedule, result)
-        if not result.ok:
-            failures += 1
-    print(f"explore: {hi - lo} schedules, {failures} failure(s)")
-    return 1 if failures else 0
+
+    pairs = []  # (schedule, result), seed order
+    if args.budget_s:
+        # wall-clock budget mode: keep the population full (a finished
+        # lane refills with the next seed) until the budget expires —
+        # seeds start at LO and the HI bound is ignored, the budget IS
+        # the bound
+        from ..sim import run_budget
+
+        rep = run_budget(
+            make_schedule, budget_s=args.budget_s,
+            population=max(1, args.population), start_seed=lo,
+        )
+        pairs = list(zip(rep.schedules, rep.results))
+        for schedule, result in pairs:
+            _report(f"seed {schedule.seed}", schedule, result)
+        print(
+            f"explore: {len(pairs)} schedules in {rep.wall_s:.1f}s "
+            f"(budget {args.budget_s:g}s, {rep.refills} refill(s)), "
+            f"{sum(1 for _, r in pairs if not r.ok)} failure(s)"
+        )
+    elif args.population > 1:
+        from ..sim import run_population
+
+        rep = run_population(
+            [make_schedule(s) for s in range(lo, hi)],
+            population=args.population,
+        )
+        pairs = list(zip(rep.schedules, rep.results))
+        for schedule, result in pairs:
+            _report(f"seed {schedule.seed}", schedule, result)
+        print(
+            f"explore: {len(pairs)} schedules in {rep.wall_s:.1f}s "
+            f"(population {args.population}), "
+            f"{sum(1 for _, r in pairs if not r.ok)} failure(s)"
+        )
+    else:
+        for seed in range(lo, hi):
+            schedule = make_schedule(seed)
+            result = _execute(schedule)
+            _report(f"seed {seed}", schedule, result)
+            pairs.append((schedule, result))
+        print(
+            f"explore: {len(pairs)} schedules, "
+            f"{sum(1 for _, r in pairs if not r.ok)} failure(s)"
+        )
+
+    if args.coverage_out:
+        from ..sim import CoFireMatrix
+
+        matrix = CoFireMatrix()
+        for schedule, result in pairs:
+            matrix.record(schedule, result)
+        matrix.dump(args.coverage_out)
+        print(f"coverage matrix ({matrix.runs} runs) -> {args.coverage_out}")
+
+    failing = [(s, r) for s, r in pairs if not r.ok]
+    if failing and args.shrink:
+        # same ddmin flow as `run --shrink`, applied to the FIRST
+        # failure: the shrinker replays serially, so a violation found
+        # inside a population shrinks to the same replayable fixture
+        from ..sim import shrink, to_fixture
+
+        schedule, result = failing[0]
+        small, violation = shrink(
+            schedule, result.violation, _execute, max_runs=args.shrink_budget
+        )
+        fixture = to_fixture(small, violation)
+        with open(args.shrink, "w") as f:
+            json.dump(fixture, f, indent=1)
+            f.write("\n")
+        print(
+            f"  shrunk seed {schedule.seed} to {len(small.steps)} steps / "
+            f"{small.n_replicas} replicas / faults "
+            f"{small.faults.enabled_classes()} -> {args.shrink}"
+        )
+    return 1 if failing else 0
 
 
 def _expand_fixtures(paths: list[str]) -> list[str]:
@@ -222,6 +305,23 @@ def main(argv=None) -> int:
     p_exp = sub.add_parser("explore", help="sweep a seed range")
     p_exp.add_argument("--seeds", default="0:10", metavar="LO:HI")
     common(p_exp)
+    p_exp.add_argument("--population", type=int, default=1, metavar="P",
+                       help="run P schedules concurrently through one "
+                       "shared substrate (sim/population.py); results "
+                       "are bit-identical to serial runs — the "
+                       "determinism law docs/simulation.md pins")
+    p_exp.add_argument("--budget-s", type=float, default=0.0, metavar="N",
+                       help="wall-clock budget mode: keep the population "
+                       "full, refilling finished lanes with the next "
+                       "seed (starting at LO; HI is ignored) until N "
+                       "seconds elapse — in-flight schedules finish")
+    p_exp.add_argument("--coverage-out", metavar="F.json",
+                       help="dump the fault-class × vocabulary co-fire "
+                       "matrix (render with obs_report simcov)")
+    p_exp.add_argument("--shrink", metavar="OUT.json",
+                       help="on failure, ddmin the first failing "
+                       "schedule to a minimal fixture")
+    p_exp.add_argument("--shrink-budget", type=int, default=200)
     p_exp.set_defaults(fn=_cmd_explore)
 
     p_rep = sub.add_parser("replay", help="replay committed fixtures")

@@ -1,0 +1,554 @@
+"""Population runner (ISSUE 18): P schedules through ONE shared
+substrate, bit-identical to their serial runs.
+
+The contract under test is the determinism law (docs/simulation.md
+"Population runs"): a schedule's fingerprint and fault tallies must not
+move when it runs concurrently with others through the shared
+FoldService/accelerator — every RNG stream is per-(schedule, replica,
+family, counter), so cooperative interleaving cannot shift a draw.
+Around it: the wall-clock budget mode (gates STARTS, never kills a
+lane), the explore→ddmin flow for violations found inside a population,
+the fault×vocabulary co-fire matrix and its ``obs_report simcov``
+renderer, the bench refusal guard + trend pickup, the shared-owner
+service entry, the counter tap the per-lane quarantine tally rides, and
+attribution's sim-span blindness.
+"""
+
+import asyncio
+import json
+import pathlib
+import threading
+
+import pytest
+
+from crdt_enc_tpu.obs import attribution, fleet, runtime as obs_runtime, sink
+from crdt_enc_tpu.sim import (
+    CoFireMatrix,
+    FaultConfig,
+    PopulationReport,
+    Schedule,
+    Step,
+    Violation,
+    generate,
+    run_budget,
+    run_population,
+    run_schedule,
+    verify_serial_equality,
+)
+from crdt_enc_tpu.sim.coverage import VOCABULARIES
+from crdt_enc_tpu.sim.population import PopulationSubstrate
+from crdt_enc_tpu.sim.runner import SimResult
+from crdt_enc_tpu.tools import obs_report
+from crdt_enc_tpu.tools import sim as sim_cli
+from crdt_enc_tpu.utils import trace
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+# -------------------------------------------------- the determinism law
+
+
+def test_population_bit_identical_to_serial_mixed_vocabs():
+    """THE contract: a mixed-vocabulary population (base, deltas,
+    daemon+strong-reads lanes side by side) produces, per schedule, the
+    exact fingerprint and fault tallies of its serial run — checked by
+    the same verifier CI and the bench refusal guard use."""
+    schedules = [
+        generate(0, 3, 40, FaultConfig.all_faults(), members=6),
+        generate(1, 3, 40, FaultConfig.all_faults(), members=6,
+                 deltas=True),
+        generate(2, 3, 40, FaultConfig.all_faults(), members=6,
+                 daemon=True, strong_reads=True),
+    ]
+    report = run_population(schedules, population=2)
+    assert [r.ok for r in report.results] == [True] * 3, report.violations
+    # 3 schedules over 2 lanes: exactly one lane pulled a second one
+    assert report.refills == 1
+    assert verify_serial_equality(report) == []
+    # determinism of the population run itself: same inputs, same bytes
+    again = run_population(schedules, population=3)
+    assert [r.fingerprint for r in again.results] == [
+        r.fingerprint for r in report.results
+    ]
+
+
+def test_population_rejects_fs_backend():
+    """The fs backend keeps thread-pool timing and cannot honor the
+    serial-equality contract — refused loudly, not silently degraded."""
+    sched = generate(0, 3, 10, FaultConfig.none(), backend="fs")
+    with pytest.raises(ValueError, match="memory-backend only"):
+        run_population([sched])
+
+
+def test_verify_serial_equality_catches_divergence():
+    """The checker itself must not be a rubber stamp: a doctored
+    fingerprint or fault tally is reported, named by seed."""
+    sched = generate(3, 3, 20, FaultConfig.all_faults(), members=6)
+    report = run_population([sched])
+    assert verify_serial_equality(report) == []
+    forged = PopulationReport(
+        schedules=list(report.schedules),
+        results=[SimResult(None, fingerprint="f" * 64,
+                           fault_stats=report.results[0].fault_stats)],
+    )
+    problems = verify_serial_equality(forged)
+    assert len(problems) == 1 and "seed 3" in problems[0]
+    forged2 = PopulationReport(
+        schedules=list(report.schedules),
+        results=[SimResult(None,
+                           fingerprint=report.results[0].fingerprint)],
+    )
+    assert any("fault tallies" in p for p in verify_serial_equality(forged2))
+
+
+# ------------------------------------------------------- budget mode
+
+
+def test_budget_gates_starts_and_refills_lanes(monkeypatch):
+    """`--budget-s` semantics on a deterministic clock: lanes start
+    schedules only while the budget is open, a finished lane refills
+    with the next seed, in-flight schedules always run to completion
+    (the ±1-cycle contract), and the seeds drawn are contiguous from
+    ``start_seed`` — no seed is ever skipped or half-run."""
+    from crdt_enc_tpu.sim import population as pop_mod
+
+    class FakeTime:
+        def __init__(self, step):
+            self.now, self.step = 0.0, step
+
+        def perf_counter(self):
+            self.now += self.step
+            return self.now
+
+    # calls: t0=0.25 | lane1 0.50 (ok, s0) | lane2 0.75 (ok, s1) |
+    # first finisher 1.00 (ok -> REFILL s2) | 1.25, 1.50 (expired) |
+    # final wall — 3 schedules, 1 refill, both lanes' last runs finish
+    monkeypatch.setattr(pop_mod, "time", FakeTime(0.25))
+    substrate = PopulationSubstrate()
+    try:
+        report = run_budget(
+            lambda seed: generate(seed, 2, 5, FaultConfig.none(),
+                                  members=4),
+            budget_s=1.0, population=2, start_seed=10,
+            substrate=substrate,
+        )
+    finally:
+        substrate.close()
+    assert [s.seed for s in report.schedules] == [10, 11, 12]
+    assert report.refills == 1
+    assert all(r.ok for r in report.results)
+    # every started schedule produced a full result (never killed)
+    assert all(r.fingerprint for r in report.results)
+
+
+# -------------------------------------- explore CLI: population + shrink
+
+
+def test_explore_population_cli_with_coverage_out(tmp_path, capsys):
+    """`tools.sim explore --population P --coverage-out` end to end:
+    exit 0, per-seed reports, and a loadable co-fire matrix counting
+    exactly the swept runs."""
+    cov = tmp_path / "cov.json"
+    rc = sim_cli.main([
+        "explore", "--seeds", "0:2", "--replicas", "2", "--steps", "25",
+        "--members", "6", "--faults", "all", "--population", "2",
+        "--coverage-out", str(cov),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "population 2" in out
+    matrix = CoFireMatrix.load(str(cov))
+    assert matrix.runs == 2
+    # base vocabulary always on; no run enabled the extensions
+    assert all(
+        matrix.cells[(f, v)] == 0
+        for f in FaultConfig.CLASSES
+        for v in ("deltas", "daemon", "strong_reads")
+    )
+    assert sum(matrix.cells[(f, "base")] for f in FaultConfig.CLASSES) > 0
+
+
+def test_explore_population_refuses_fs_backend():
+    with pytest.raises(SystemExit, match="backend memory"):
+        sim_cli.main([
+            "explore", "--seeds", "0:2", "--backend", "fs",
+            "--population", "2",
+        ])
+    with pytest.raises(SystemExit, match="backend memory"):
+        sim_cli.main([
+            "explore", "--seeds", "0:2", "--backend", "fs",
+            "--budget-s", "1",
+        ])
+
+
+def test_explore_population_violation_shrinks_to_replayable_fixture(
+    tmp_path, monkeypatch, capsys
+):
+    """Satellite: a violation found INSIDE a population still ddmin-
+    shrinks to a replayable fixture.  The population stage is faked to
+    report one failing schedule (a synthetic two-step oracle, the
+    shrinker-test idiom); the shrink itself runs the real ddmin through
+    the CLI's serial executor, and the written fixture must be minimal,
+    schema-clean, and replayable by the real runner."""
+    import crdt_enc_tpu.sim as sim_pkg
+
+    base = generate(0, 3, 30, FaultConfig.all_faults())
+    needles = [Step("rotate", 2), Step("compact", 2)]
+    bad = base.with_steps(list(base.steps) + needles)
+    violation = Violation("divergence", "synthetic", step=3)
+
+    def fake_run_population(schedules, *, population=None, substrate=None):
+        return PopulationReport(
+            schedules=[generate(1, 3, 30, FaultConfig.all_faults()), bad],
+            results=[SimResult(None, fingerprint="a" * 64),
+                     SimResult(violation)],
+        )
+
+    def oracle(s):
+        has = {(st.kind, st.replica) for st in s.steps}
+        if ("rotate", 2) in has and ("compact", 2) in has:
+            return SimResult(Violation("divergence", "synthetic"))
+        return SimResult(None)
+
+    monkeypatch.setattr(sim_pkg, "run_population", fake_run_population)
+    monkeypatch.setattr(sim_cli, "_execute", oracle)
+    out_path = tmp_path / "shrunk.json"
+    rc = sim_cli.main([
+        "explore", "--seeds", "0:2", "--population", "2",
+        "--shrink", str(out_path),
+    ])
+    assert rc == 1
+    assert "shrunk seed 0" in capsys.readouterr().out
+    with open(out_path) as f:
+        fixture = json.load(f)
+    small = Schedule.from_obj(fixture)  # schema-clean
+    kinds = sorted((s.kind, s.replica) for s in small.steps)
+    assert kinds == [("compact", 2), ("rotate", 2)]
+    assert small.faults.enabled_classes() == []
+    assert fixture["violation"]["invariant"] == "divergence"
+    # replayable by the REAL runner (monkeypatch bypassed), and — like
+    # every committed fixture — now passing
+    assert run_schedule(small).ok
+
+
+# ------------------------------------------------- co-fire coverage map
+
+
+def _result_firing(*classes):
+    r = SimResult(None)
+    for c in classes:
+        r.fault_stats[c] = 3
+    return r
+
+
+def test_cofire_matrix_counts_holes_and_roundtrips(tmp_path):
+    m = CoFireMatrix()
+    m.record(generate(0, 3, 10, FaultConfig.all_faults()),
+             _result_firing("torn_read"))
+    m.record(generate(1, 3, 10, FaultConfig.all_faults(), deltas=True),
+             _result_firing("torn_read", "write_crash"))
+    assert m.runs == 2
+    assert m.cells[("torn_read", "base")] == 2
+    assert m.cells[("torn_read", "deltas")] == 1
+    assert m.cells[("write_crash", "deltas")] == 1
+    assert m.cells[("write_crash", "daemon")] == 0
+    holes = m.holes()
+    assert ("torn_read", "base") not in holes
+    assert ("stale_checkpoint", "base") in holes
+    # enabled-but-never-fired is a hole too: firing is what counts
+    assert ("dup_delivery", "base") in holes
+
+    m.dump(str(tmp_path / "cov.json"))
+    again = CoFireMatrix.load(str(tmp_path / "cov.json"))
+    assert again.to_obj() == m.to_obj()
+    with pytest.raises(ValueError, match="version"):
+        CoFireMatrix.from_obj({**m.to_obj(), "version": 99})
+
+    table = m.render()
+    assert "torn_read" in table and all(v in table for v in VOCABULARIES)
+    assert "never-co-fired" in table
+    full = CoFireMatrix()
+    full.record(
+        generate(2, 3, 10, FaultConfig.all_faults(), deltas=True,
+                 daemon=True, strong_reads=True),
+        _result_firing(*FaultConfig.CLASSES),
+    )
+    assert full.holes() == []
+    assert "every fault×vocabulary pair has co-fired" in full.render()
+
+
+def test_simcov_cli_renders_json_and_rejects_garbage(tmp_path, capsys):
+    m = CoFireMatrix()
+    m.record(generate(0, 3, 10, FaultConfig.all_faults()),
+             _result_firing("torn_read"))
+    path = tmp_path / "cov.json"
+    m.dump(str(path))
+    assert obs_report.main(["simcov", str(path)]) == 0
+    assert "torn_read" in capsys.readouterr().out
+    assert obs_report.main(["simcov", str(path), "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["runs"] == 1 and obj["cells"]["torn_read:base"] == 1
+    (tmp_path / "junk.json").write_text("{nope")
+    assert obs_report.main(["simcov", str(tmp_path / "junk.json")]) == 2
+    assert "unreadable" in capsys.readouterr().err
+
+
+# ------------------------------------------------ bench + trend pickup
+
+
+def test_bench_sim_population_record_and_refusal_guard(monkeypatch, capsys):
+    """Satellite: ``bench.py --sim --population P`` commits a
+    ``_pP``-suffixed record only when every schedule's fingerprint
+    matches its serial twin — a doctored verifier must abort the
+    record, a clean run must stamp ``serial_equivalent``."""
+    import bench
+
+    monkeypatch.setenv("BENCH_LOCAL_DISABLE", "1")
+    monkeypatch.setenv("BENCH_SIM_SEEDS", "2")
+    monkeypatch.setattr(
+        "sys.argv",
+        ["bench.py", "--sim", "--replicas", "2", "--steps", "20",
+         "--population", "2"],
+    )
+    bench.bench_sim(smoke=True)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["config"] == "sim_2r_20s_all_p2"
+    assert rec["population"] == 2
+    assert rec["serial_equivalent"] is True
+    assert rec["violations"] == 0
+    assert rec["metric"] == "sim_schedules_per_sec"
+
+    import crdt_enc_tpu.sim as sim_pkg
+
+    monkeypatch.setattr(
+        sim_pkg, "verify_serial_equality",
+        lambda report: ["seed 0: population fingerprint aaaa != serial bbbb"],
+    )
+    with pytest.raises(SystemExit, match="refusing to record"):
+        bench.bench_sim(smoke=True)
+
+
+def test_population_record_rides_the_trend_gate(tmp_path):
+    """The committed ``--sim --population`` record is a first-class
+    trend config, SEPARATE from the serial sim series (the ``_pP``
+    suffix), holds the ≥5× bar over the 0.37 serial baseline, and the
+    ``--fail-on-regression`` math applies to it."""
+    records = sink.read_records(str(REPO / "BENCH_LOCAL.jsonl"))
+    pop_recs = [
+        r for r in records
+        if r.get("metric") == "sim_schedules_per_sec"
+        and r.get("population", 0) > 1
+    ]
+    assert pop_recs, "committed BENCH_LOCAL carries no population record"
+    rec = pop_recs[-1]
+    assert rec["config"].endswith(f"_p{rec['population']}")
+    assert rec["serial_equivalent"] is True
+    assert rec["violations"] == 0
+    assert rec["replicas"] >= 8 and rec["steps"] >= 250
+    assert rec["value"] >= 5 * 0.37  # the ISSUE-18 acceptance bar
+
+    trend = fleet.bench_trend(records, metric="sim_schedules_per_sec")
+    pop_cfgs = [
+        c for c in trend if "_p" in c["shape"].get("config", "")
+    ]
+    serial_cfgs = [
+        c for c in trend if c["shape"].get("config") == "sim_8r_250s_all"
+    ]
+    assert pop_cfgs, "population config collapsed into the serial series"
+    assert serial_cfgs, "serial baseline series disappeared"
+    # the regression gate picks the new series up like any other
+    regressed = dict(rec, value=rec["value"] / 10)
+    t2 = fleet.bench_trend(
+        records + [regressed], metric="sim_schedules_per_sec"
+    )
+    assert any(
+        "_p" in c["shape"].get("config", "")
+        for c in fleet.trend_regressions(t2, 45)
+    )
+
+
+# --------------------------------------------- obs: taps + attribution
+
+
+def test_counter_tap_is_context_local_and_nests():
+    trace.add("tap_probe_total", 0)
+    with trace.counter_tap() as outer:
+        trace.add("tap_probe_total", 2)
+        with trace.counter_tap() as inner:
+            trace.add("tap_probe_total", 5)
+        trace.add("tap_probe_total", 1)
+    # inner sees only its window; outer sees everything in its window
+    assert inner == {"tap_probe_total": 5}
+    assert outer == {"tap_probe_total": 8}
+    trace.add("tap_probe_total", 100)
+    assert outer == {"tap_probe_total": 8}  # closed taps are closed
+
+    async def scenario():
+        with trace.counter_tap() as tap:
+            async def child():
+                trace.add("tap_probe_total", 3)
+            # tasks and to_thread copy the context at creation: a lane's
+            # whole task tree lands in the lane's tap
+            await asyncio.gather(child(), asyncio.create_task(child()))
+            await asyncio.to_thread(trace.add, "tap_probe_total", 4)
+        return tap
+
+    tap = asyncio.run(scenario())
+    assert tap == {"tap_probe_total": 10}
+
+    # a PLAIN thread does not inherit the context — and must not leak
+    # its increments into a tap it was never inside
+    with trace.counter_tap() as tap2:
+        t = threading.Thread(target=trace.add, args=("tap_probe_total", 7))
+        t.start()
+        t.join()
+    assert tap2 == {}
+
+
+def test_attribution_ignores_sim_spans():
+    """Sim harness spans wrap the serve spans a sim service cycle
+    records; attribution must drop them or a whole simulation reads as
+    one impossibly slow cycle."""
+    snap = {
+        "spans": {
+            "sim.population": {"count": 1, "seconds": 500.0},
+            "sim.run": {"count": 4, "seconds": 480.0},
+            "serve.cycle": {"count": 1, "seconds": 2.0},
+            "serve.fold": {"count": 1, "seconds": 0.5},
+        },
+        "counters": {}, "gauges": {},
+    }
+    rep = attribution.attribute_cycle(snap, ops=100)
+    assert rep["pipeline"] == "serve"
+    assert rep["wall_s"] == 2.0  # serve.cycle, not the sim envelope
+    for stage in rep["stages"].values():
+        assert not any(n.startswith("sim.") for n in stage["spans"])
+
+    def ev(name, t0, t1):
+        return {"name": name, "kind": "span", "t0": t0, "t1": t1,
+                "meta": None, "tid": 1, "thread": "t"}
+
+    rep2 = attribution.attribute_cycle(
+        {"spans": {"serve.fold": {"count": 1, "seconds": 0.5}},
+         "counters": {}, "gauges": {}},
+        pipeline="serve",
+        events=[ev("sim.run", 0.0, 500.0), ev("serve.fold", 1.0, 1.5)],
+    )
+    assert rep2["wall_s"] == 0.5  # event extent excludes sim.* too
+
+
+# --------------------------------------- shared service + compile classes
+
+
+def test_run_cycle_shared_queues_concurrent_owners():
+    """Two owners driving one FoldService concurrently must queue and
+    both seal — where bare ``run_cycle`` refuses reentrancy — and the
+    lock survives a second event loop (a service outliving one
+    ``asyncio.run``)."""
+    from crdt_enc_tpu.backends import (
+        IdentityCryptor, MemoryRemote, MemoryStorage, PlainKeyCryptor,
+    )
+    from crdt_enc_tpu.core import Core, OpenOptions, orset_adapter
+    from crdt_enc_tpu.parallel import TpuAccelerator
+    from crdt_enc_tpu.serve import FoldService
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    def opts(storage):
+        return OpenOptions(
+            storage=storage, cryptor=IdentityCryptor(),
+            key_cryptor=PlainKeyCryptor(), adapter=orset_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1, create=True,
+            accelerator=TpuAccelerator(min_device_batch=1),
+        )
+
+    async def build_core(tag):
+        core = await Core.open(opts(MemoryStorage(MemoryRemote())))
+        for i in range(8):
+            await core.apply_ops([core.with_state(
+                lambda s, m=b"%s-%d" % (tag, i): s.add_ctx(core.actor_id, m)
+            )])
+        return core
+
+    service = FoldService([])
+
+    async def first_loop():
+        a, b = await build_core(b"a"), await build_core(b"b")
+        ra, rb = await asyncio.gather(
+            service.run_cycle_shared([a]), service.run_cycle_shared([b]),
+        )
+        assert ra[0].error is None and ra[0].sealed
+        assert rb[0].error is None and rb[0].sealed
+
+    async def second_loop():
+        c = await build_core(b"c")
+        (rc,) = await service.run_cycle_shared([c])
+        assert rc.error is None and rc.sealed
+
+    asyncio.run(first_loop())
+    asyncio.run(second_loop())  # per-loop lock rebuild, not a crash
+    service.close()
+
+
+def test_population_compiles_constant_as_p_grows():
+    """The throughput mechanism itself: after a 2-schedule warmup, a
+    LARGER population of fresh seeds through the SAME substrate must
+    not add steady-state XLA compiles — the bucketed compile classes
+    are fleet properties, not schedule properties."""
+    obs_runtime.track_recompiles()
+    substrate = PopulationSubstrate()
+    try:
+        warm = [generate(s, 3, 30, FaultConfig.all_faults(), members=6)
+                for s in range(4)]
+        report = run_population(warm, substrate=substrate)
+        assert all(r.ok for r in report.results)
+        baseline = obs_runtime.recompile_count()
+        # the exact half of the property: the same shapes through the
+        # same substrate compile NOTHING — P lanes share one program set
+        again = run_population(warm, population=4, substrate=substrate)
+        assert all(r.ok for r in again.results)
+        assert obs_runtime.recompile_count() == baseline, (
+            "re-running warmed schedules recompiled — the shared "
+            "substrate's program cache leaked per-lane state"
+        )
+        # the asymptotic half: TWICE as many fresh seeds may only touch
+        # the occasional unwarmed bucket class (strictly sub-linear),
+        # never one-compile-set-per-schedule
+        more = [generate(s, 3, 30, FaultConfig.all_faults(), members=6)
+                for s in range(10, 18)]
+        report2 = run_population(more, population=4, substrate=substrate)
+        assert all(r.ok for r in report2.results)
+        grown = obs_runtime.recompile_count() - baseline
+        assert grown <= len(more) // 2, (
+            f"{len(more)} fresh schedules recompiled {grown} programs — "
+            "the shared substrate's compile classes leaked schedule shape"
+        )
+    finally:
+        substrate.close()
+
+
+# ------------------------------------------------------ fleet acceptance
+
+
+@pytest.mark.slow
+def test_population_acceptance_32_schedules():
+    """ISSUE-18 acceptance: a 32-schedule all-vocabulary population
+    through one substrate — zero violations, every fault class fires
+    somewhere in the population, and a serial-equality spot check on
+    the first four schedules upholds the law at scale."""
+    schedules = [
+        generate(seed, 4, 100, FaultConfig.all_faults(), members=6,
+                 deltas=True, daemon=True, strong_reads=True)
+        for seed in range(32)
+    ]
+    report = run_population(schedules, population=8)
+    assert report.violations == []
+    assert report.refills == 32 - 8
+    fired = set()
+    for r in report.results:
+        fired.update(k for k, v in r.fault_stats.items() if v)
+    assert fired == set(FaultConfig.CLASSES)
+    sample = PopulationReport(
+        schedules=report.schedules[:4], results=report.results[:4],
+    )
+    assert verify_serial_equality(sample) == []

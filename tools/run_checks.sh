@@ -58,6 +58,41 @@ JAX_PLATFORMS=cpu python -m crdt_enc_tpu.tools.sim run \
     --seed 0 --replicas 4 --steps 80 --faults all \
     --deltas --strong-reads --daemon
 
+echo "== population sim smoke (bounded, serial-equality asserted) =="
+# ISSUE-18: a small all-faults population through the ONE shared
+# substrate, then every schedule re-run serially — any fingerprint or
+# fault-tally divergence fails the build (the determinism law,
+# docs/simulation.md "Population runs")
+JAX_PLATFORMS=cpu python - <<'EOF'
+from crdt_enc_tpu.sim import (
+    FaultConfig, generate, run_population, verify_serial_equality,
+)
+
+schedules = [
+    generate(seed, 4, 100, FaultConfig.all_faults(), members=6,
+             deltas=True, daemon=True, strong_reads=True)
+    for seed in range(4)
+]
+report = run_population(schedules, population=4)
+bad = [(s.seed, r.violation) for s, r in
+       zip(report.schedules, report.results) if not r.ok]
+if bad:
+    raise SystemExit(f"population smoke violations: {bad}")
+problems = verify_serial_equality(report)
+if problems:
+    raise SystemExit(
+        "population diverged from serial twins:\n  " + "\n  ".join(problems)
+    )
+fired = set()
+for r in report.results:
+    fired.update(k for k, v in r.fault_stats.items() if v)
+missing = set(FaultConfig.CLASSES) - fired
+if missing:
+    raise SystemExit(f"population smoke never fired fault classes: {missing}")
+print(f"OK: {len(schedules)} schedules, population 4, "
+      f"wall {report.wall_s:.1f}s, serial-equal, all fault classes fired")
+EOF
+
 echo "== daemon smoke: faulted cycles -> drain -> fsck =="
 # bounded always-on daemon selftest: an in-memory fleet with injected
 # tenant faults runs supervised cycles (errors must isolate into
