@@ -1,11 +1,13 @@
 """CLI for the static-analysis engine.
 
 ``python -m crdt_enc_tpu.tools.analyze [--json] [--diff-baseline]
-[--rule RULE ...] [--list-rules] [--root DIR] [paths...]``
+[--rule RULE ...] [--effects QUALNAME] [--expect-json-version N]
+[--list-rules] [--root DIR] [paths...]``
 
 Exit codes: 0 = no unsuppressed error-severity findings (and, under
 ``--diff-baseline``, no stale baseline entries either); 1 = violations;
-2 = usage/configuration error.
+2 = usage/configuration error (including an ``--expect-json-version``
+mismatch, and an ``--effects`` qualname that matches nothing).
 """
 
 from __future__ import annotations
@@ -26,7 +28,11 @@ from .engine import (
 )
 
 BASELINE_REL = "tools/analysis_baseline.toml"
-JSON_SCHEMA_VERSION = 1
+# v2 (interprocedural effects): findings gained `chain` (the provenance
+# call path, caller-first), and `--effects` emits the per-function
+# effect dump.  Consumers pinned to a version pass --expect-json-version
+# and get a loud exit-2 reject instead of silently mis-parsing.
+JSON_SCHEMA_VERSION = 2
 
 
 def default_root() -> pathlib.Path:
@@ -52,6 +58,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--list-rules", action="store_true")
     p.add_argument(
+        "--effects", metavar="QUALNAME",
+        help="dump the inferred effect set + provenance chains for a "
+        "function (e.g. Core.open, or serve/service.py::FoldService.run_cycle)",
+    )
+    p.add_argument(
+        "--expect-json-version", type=int, default=None, metavar="N",
+        help="fail loudly (exit 2) unless the --json schema version is "
+        "exactly N — pin your consumer instead of silently mis-parsing",
+    )
+    p.add_argument(
         "--no-baseline", action="store_true",
         help="ignore the committed baseline (show everything)",
     )
@@ -59,9 +75,58 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _effects_json(idx, fi) -> dict:
+    return {
+        "key": fi.key,
+        "qualname": fi.qualname,
+        "async": fi.is_async,
+        "effects": [
+            {"kind": kind, "origin": origin,
+             "chain": idx.chain(fi.key, kind, origin)}
+            for (kind, origin) in sorted(fi.effects)
+        ],
+        "unresolved": [
+            {"path": u.rel, "line": u.line, "desc": u.desc}
+            for u in fi.unresolved
+        ],
+        "sanctioned": [
+            {"kind": kind, "line": line, "desc": desc}
+            for kind, line, desc in fi.sanctioned
+        ],
+    }
+
+
+def _print_effects(idx, fi) -> None:
+    head = "async def" if fi.is_async else "def"
+    print(f"{head} {fi.qualname}  [{fi.mod.rel}]")
+    if not fi.effects:
+        print("  effects: none")
+    for (kind, origin) in sorted(fi.effects):
+        print(f"  {kind}: {origin}")
+        for link in idx.chain(fi.key, kind, origin):
+            print(f"    via {link}")
+    for u in fi.unresolved:
+        print(f"  unresolved call at {u.rel}:{u.line}: {u.desc}")
+    for kind, line, desc in fi.sanctioned:
+        print(f"  sanctioned [{kind}] at {fi.mod.rel}:{line}: {desc}")
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     root = pathlib.Path(args.root).resolve() if args.root else default_root()
+
+    if args.expect_json_version is not None and (
+        args.expect_json_version != JSON_SCHEMA_VERSION
+    ):
+        print(
+            f"JSON schema version mismatch: this analyzer emits v"
+            f"{JSON_SCHEMA_VERSION}, consumer expects v"
+            f"{args.expect_json_version} — update the consumer "
+            "(v2 added per-finding `chain` provenance; see "
+            "docs/static_analysis.md)",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.list_rules:
         for name, (_fn, sev, doc) in sorted(all_rules().items()):
@@ -77,6 +142,30 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
+
+    if args.effects:
+        from .effects import effect_index
+
+        idx = effect_index(Project(root, None))
+        matches = idx.lookup(args.effects)
+        if not matches:
+            print(
+                f"no function matching {args.effects!r} — use a dotted "
+                "qualname (Core.open) or a key "
+                "(crdt_enc_tpu/core/core.py::Core.open)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.json:
+            print(json.dumps(
+                {"version": JSON_SCHEMA_VERSION,
+                 "functions": [_effects_json(idx, fi) for fi in matches]},
+                indent=2, sort_keys=True,
+            ))
+        else:
+            for fi in matches:
+                _print_effects(idx, fi)
+        return 0
 
     try:
         rules = args.rules

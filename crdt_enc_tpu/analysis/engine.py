@@ -58,16 +58,23 @@ class Finding:
     message: str
     context: str = "<module>"  # enclosing function qualname
     suppressed: str | None = None  # None | "pragma" | "baseline"
+    #: effect provenance — the call path that introduced the effect,
+    #: caller-first (populated by the interprocedural rules; None for
+    #: the per-node pattern rules)
+    chain: list[str] | None = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
 
     def render(self) -> str:
         tag = f" [{self.suppressed}]" if self.suppressed else ""
-        return (
+        head = (
             f"{self.severity.upper()} {self.rule} {self.path}:{self.line} "
             f"({self.context}): {self.message}{tag}"
         )
+        if self.chain:
+            head += "".join(f"\n    via {link}" for link in self.chain)
+        return head
 
 
 class ModuleInfo:
@@ -82,6 +89,7 @@ class ModuleInfo:
         self.pragmas = self._collect_pragmas(self.lines)
         self.parents: dict[ast.AST, ast.AST] = {}
         self.qualname: dict[ast.AST, str] = {}
+        self._all_nodes: list[ast.AST] | None = None  # walk() cache
         self._index(self.tree, None, ())
 
     @staticmethod
@@ -116,9 +124,14 @@ class ModuleInfo:
         return self.qualname.get(node, "<module>")
 
     def walk(self, *types) -> Iterator[ast.AST]:
-        for node in ast.walk(self.tree):
-            if not types or isinstance(node, types):
-                yield node
+        # every rule re-walks every module; one cached flat list turns
+        # the repeated traversals into plain list scans
+        nodes = self._all_nodes
+        if nodes is None:
+            nodes = self._all_nodes = list(ast.walk(self.tree))
+        if not types:
+            return iter(nodes)
+        return (n for n in nodes if isinstance(n, types))
 
 
 class Project:

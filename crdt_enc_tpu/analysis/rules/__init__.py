@@ -3,4 +3,16 @@ engine registry (``crdt_enc_tpu.analysis.engine.rule``); adding a rule
 is: write a module here, decorate the entry point, import it below, and
 document it in docs/static_analysis.md."""
 
-from . import exc, ffi, jit, obs, sec, spans, threads  # noqa: F401
+from . import (  # noqa: F401
+    async_,
+    determinism,
+    exc,
+    ffi,
+    jit,
+    locks,
+    mutation,
+    obs,
+    sec,
+    spans,
+    threads,
+)

@@ -399,6 +399,14 @@ class Core:
     @classmethod
     async def open(cls, opts: OpenOptions) -> "Core":
         core = cls(opts)
+        # warm the native libraries off-loop before the first codec.pack
+        # below can reach them: the build-on-demand loader runs `make`
+        # once per process, and that subprocess must never run on the
+        # event loop (ASY001).  warm() memoizes failure too, so after
+        # this every load()/load_state() probe is a cached dict hit.
+        from .. import native
+
+        await asyncio.to_thread(native.warm)
         raw = await core.storage.load_local_meta()
         if raw is None:
             if not opts.create:
@@ -2452,7 +2460,9 @@ class Core:
         epoch still matches, else the live state is serialized here.
         The canonical packer re-sorts maps, so an equivalent obj seals
         byte-identical payloads."""
-        # sync snapshot section
+        # lint: sync-section-begin (ASY001: the snapshot/cursor/delta-plan
+        # cut below must come from ONE loop slice — an await here lets an
+        # ingest interleave and seal a torn (state, cursor, delta) triple)
         d = self._data
         if _state_obj is not None and _state_obj[1] == getattr(
             d.state, "_mut", None
@@ -2488,6 +2498,7 @@ class Core:
         deltas_to_remove = sorted(
             (a, v) for a, v in d.read_deltas.items() if a != self.actor_id
         )
+        # lint: sync-section-end
         with trace.span("compact.seal"):
             blob = await self._seal(payload)
         # crash safety: the new snapshot is durable before anything vanishes

@@ -102,6 +102,11 @@ class CrdtMap:
     # sub-ops (e.g. a child remove citing an actor the remover never
     # saw) are never lost to suppression.
     deferred: dict = field(default_factory=dict)
+    # mutation epoch: bumped by every mutating method (and by the
+    # accelerator's fold writebacks, ops/map_columnar.py) so caches and
+    # checkpoint stashes can key their validity on it — same law as
+    # ORSet._mut (MUT001 enforces it statically)
+    _mut: int = field(default=0, compare=False, repr=False)
 
     def __post_init__(self):
         if self.child not in CHILD_TYPES:
@@ -133,6 +138,7 @@ class CrdtMap:
 
     # -- CmRDT -------------------------------------------------------------
     def apply(self, op) -> None:
+        self._mut += 1
         if isinstance(op, (list, tuple)):
             op = self.op_from_obj(op)
         if isinstance(op, UpOp):
@@ -240,6 +246,7 @@ class CrdtMap:
     def merge(self, other: "CrdtMap") -> None:
         if self.child != other.child:
             raise ValueError("cannot merge maps with different child types")
+        self._mut += 1
         keys = (
             set(self.births) | set(other.births)
             | set(self.vals) | set(other.vals)  # residue-only keys too

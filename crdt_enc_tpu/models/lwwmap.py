@@ -49,6 +49,11 @@ def _wins(a_ts, a_actor, a_val, a_tomb, b_ts, b_actor, b_val, b_tomb) -> bool:
 class LWWMap:
     # key -> [ts, actor, value, tombstone]
     entries: dict = field(default_factory=dict)
+    # mutation epoch: bumped by every mutating method (and by the
+    # accelerator's writebacks) — same cache-validity law as ORSet._mut
+    # (MUT001 enforces it statically); excluded from the semantic
+    # __eq__ below
+    _mut: int = field(default=0, compare=False, repr=False)
 
     def put(self, key, ts: int, actor: Actor, value) -> LWWOp:
         return LWWOp(key, ts, actor, value)
@@ -57,6 +62,7 @@ class LWWMap:
         return LWWOp(key, ts, actor, None, tombstone=True)
 
     def apply(self, op) -> None:
+        self._mut += 1
         if isinstance(op, (list, tuple)):
             op = LWWOp.from_obj(op)
         cur = self.entries.get(op.key)
@@ -65,6 +71,7 @@ class LWWMap:
             self.entries[op.key] = new
 
     def merge(self, other: "LWWMap") -> None:
+        self._mut += 1
         for key, theirs in other.entries.items():
             cur = self.entries.get(key)
             if cur is None or _wins(*theirs, *cur):

@@ -29,11 +29,11 @@ def _build_and_load(target: str, so_path: str, dll_cls, bind_fn):
     dev headers still gets the header-free crypto/codec library even
     though the C-API state library cannot compile there.
     """
-    os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)
-    with open(os.path.join(_HERE, "build", ".lock"), "w") as lk:
+    os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)  # lint: effect-ok=blocks (one-shot memoized build; warm() runs it off-loop)
+    with open(os.path.join(_HERE, "build", ".lock"), "w") as lk:  # lint: effect-ok=blocks (one-shot memoized build; warm() runs it off-loop)
         fcntl.flock(lk, fcntl.LOCK_EX)
         try:
-            subprocess.run(
+            subprocess.run(  # lint: effect-ok=blocks (one-shot memoized build; warm() runs it off-loop)
                 ["make", "-C", _HERE, target],
                 check=True,
                 capture_output=True,
@@ -47,6 +47,24 @@ def _build_and_load(target: str, so_path: str, dll_cls, bind_fn):
         lib = dll_cls(so_path)
     bind_fn(lib)
     return lib
+
+
+def warm() -> None:
+    """Build/load both native libraries now, swallowing failures.
+
+    The loaders memoize success *and* failure, so after one ``warm()``
+    every later ``load()``/``load_state()`` call is a cached dict hit —
+    no ``make`` subprocess, no dlopen.  Event-loop code calls this once
+    via ``asyncio.to_thread`` at open (see ``Core.open``) so the
+    first-use build never runs on the loop; callers that need the
+    library still probe the loaders themselves and fall back to the
+    Python paths when the build failed.
+    """
+    for loader in (load, load_state):
+        try:
+            loader()
+        except Exception:
+            pass  # cached by the loader; pure-Python fallbacks take over
 
 
 def load() -> ctypes.CDLL:

@@ -411,6 +411,10 @@ def _orset_fresh_fold_native(
     except Exception as e:
         _warn_no_native_state(e)
         return None
+    # self-protecting epoch bump (MUT001): the caller bumps too, but the
+    # native writeback below mutates entries/deferred/clock directly and
+    # must not depend on every future caller remembering to
+    state._mut += 1
     E, R = len(members), len(replicas)
     kind = np.ascontiguousarray(kind, np.int8)
     member32 = np.ascontiguousarray(member, np.int32)

@@ -303,6 +303,7 @@ def crdtmap_fold_host(
         )
 
     # ---- planes → state --------------------------------------------------
+    state._mut += 1  # writeback mutates the state outside its methods
     robj = replicas.items
     state.clock = VClock(
         {robj[r]: int(clock[r]) for r in np.nonzero(clock)[0]}

@@ -1,0 +1,723 @@
+"""The interprocedural effect engine (analysis/effects.py) and the four
+rule families built on it (ASY001, DET001, MUT001, LCK001).
+
+Engine unit tests pin the call-graph semantics the rules depend on —
+seed tables, propagation, laundering seams, honest widening (ambiguous
+and dynamic calls recorded as unresolved, never guessed), the
+``effect-ok`` origin-sanction pragma — then per-rule positive/negative
+fixture pairs, the ``--effects``/``--expect-json-version`` CLI surface,
+the partial-run contract for the new rule ids, and runtime regression
+tests for the genuine findings this PR's rules surfaced and fixed
+(CrdtMap/LWWMap ``_mut`` epochs, fold-writeback bumps, Core.open
+warming the native build off-loop).
+
+Fixtures are parsed, never executed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import pathlib
+import textwrap
+import uuid
+
+import numpy as np
+import pytest
+
+from crdt_enc_tpu.analysis import Project, run, unsuppressed_errors
+from crdt_enc_tpu.analysis.cli import main as cli_main
+from crdt_enc_tpu.analysis.effects import (
+    KIND_BLOCKS,
+    KIND_RNG,
+    KIND_WALL,
+    effect_index,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def project_of(tmp_path, files: dict) -> Project:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Project(tmp_path)
+
+
+def errors_of(tmp_path, files, rules):
+    findings = run(project_of(tmp_path, files), rules, None)
+    return unsuppressed_errors(findings)
+
+
+def one_func(idx, qualname):
+    (fi,) = idx.lookup(qualname)
+    return fi
+
+
+# ----------------------------------------------------- effect engine
+
+
+def test_direct_seeds_classified(tmp_path):
+    idx = effect_index(project_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            import os
+            import time
+
+            def sleeper():
+                time.sleep(1)
+
+            def clocky():
+                return time.time()
+
+            def dicey():
+                return os.urandom(8)
+            """,
+    }))
+    assert one_func(idx, "sleeper").effect_kinds() == {KIND_BLOCKS}
+    assert one_func(idx, "clocky").effect_kinds() == {KIND_WALL}
+    assert one_func(idx, "dicey").effect_kinds() == {KIND_RNG}
+
+
+def test_propagation_builds_provenance_chain(tmp_path):
+    idx = effect_index(project_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            import time
+
+            def leaf():
+                time.sleep(1)
+
+            def mid():
+                leaf()
+
+            def top():
+                mid()
+            """,
+    }))
+    top = one_func(idx, "top")
+    assert KIND_BLOCKS in top.effect_kinds()
+    chain = idx.chain(top.key, KIND_BLOCKS, "time.sleep")
+    # caller-first: top -> mid, mid -> leaf, leaf: the sleep itself
+    assert len(chain) == 3
+    assert "top" in chain[0] and "mid" in chain[1] and "time.sleep" in chain[2]
+
+
+def test_awaits_effect_does_not_propagate(tmp_path):
+    idx = effect_index(project_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            import asyncio
+
+            async def inner():
+                await asyncio.sleep(0)
+
+            def outer():
+                return inner()
+            """,
+    }))
+    assert "awaits" in one_func(idx, "inner").effect_kinds()
+    assert "awaits" not in one_func(idx, "outer").effect_kinds()
+
+
+def test_to_thread_and_executor_launder_blocks(tmp_path):
+    idx = effect_index(project_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            import asyncio
+            import functools
+            import time
+
+            def work():
+                time.sleep(1)
+
+            async def laundered():
+                await asyncio.to_thread(work)
+
+            async def laundered_partial(loop):
+                await loop.run_in_executor(None, functools.partial(work))
+
+            async def guilty():
+                work()
+            """,
+    }))
+    assert KIND_BLOCKS not in one_func(idx, "laundered").effect_kinds()
+    assert KIND_BLOCKS not in one_func(idx, "laundered_partial").effect_kinds()
+    assert KIND_BLOCKS in one_func(idx, "guilty").effect_kinds()
+
+
+def test_ambiguous_and_dynamic_calls_widen_honestly(tmp_path):
+    """2+ same-named defs and non-name callees are recorded as
+    unresolved — never resolved by guess, never silently dropped."""
+    idx = effect_index(project_of(tmp_path, {
+        "crdt_enc_tpu/a.py": """\
+            import time
+
+            def helper():
+                time.sleep(1)
+            """,
+        "crdt_enc_tpu/b.py": """\
+            def helper():
+                return 2
+            """,
+        "crdt_enc_tpu/c.py": """\
+            def caller(obj):
+                obj.helper()
+
+            def dyn(fns):
+                fns[0]()
+            """,
+    }))
+    caller = one_func(idx, "caller")
+    # the ambiguity must NOT leak a.helper's blocks effect into caller
+    assert KIND_BLOCKS not in caller.effect_kinds()
+    assert any("ambiguous" in u.desc for u in caller.unresolved)
+    dyn = one_func(idx, "dyn")
+    assert any("dynamic call" in u.desc for u in dyn.unresolved)
+    assert not dyn.effect_kinds()
+
+
+def test_effect_ok_pragma_sanctions_that_line_only(tmp_path):
+    idx = effect_index(project_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            def build():
+                with open("x", "w") as f:  # lint: effect-ok=blocks (one-shot)
+                    f.write("y")
+
+            def plain():
+                with open("x") as f:
+                    return f.read()
+            """,
+    }))
+    build = one_func(idx, "build")
+    assert KIND_BLOCKS not in build.effect_kinds()
+    assert [(k, d) for k, _ln, d in build.sanctioned] == [
+        (KIND_BLOCKS, "call to open")
+    ]
+    # a pragma sanctions its own line, not the origin everywhere
+    assert KIND_BLOCKS in one_func(idx, "plain").effect_kinds()
+
+
+# ------------------------------------------------------------- ASY001
+
+
+def test_asy_blocking_in_async_caught_with_chain(tmp_path):
+    errors = errors_of(tmp_path, {
+        "crdt_enc_tpu/serve/m.py": """\
+            import time
+
+            def decode():
+                time.sleep(1)
+
+            async def cycle():
+                decode()
+            """,
+    }, ["ASY001"])
+    (f,) = errors
+    assert "time.sleep" in f.message and f.context == "cycle"
+    assert f.chain and "decode" in f.chain[0]
+
+
+def test_asy_to_thread_seam_passes(tmp_path):
+    assert not errors_of(tmp_path, {
+        "crdt_enc_tpu/serve/m.py": """\
+            import asyncio
+            import time
+
+            def decode():
+                time.sleep(1)
+
+            async def cycle():
+                await asyncio.to_thread(decode)
+            """,
+    }, ["ASY001"])
+
+
+def test_asy_out_of_scope_async_passes(tmp_path):
+    assert not errors_of(tmp_path, {
+        "crdt_enc_tpu/utils/m.py": """\
+            import time
+
+            async def helper():
+                time.sleep(1)
+            """,
+    }, ["ASY001"])
+
+
+def test_asy_sync_section_await_caught(tmp_path):
+    src = """\
+        async def seal(self):
+            # lint: sync-section-begin
+            d = self._data
+            await self.storage.put(d)
+            # lint: sync-section-end
+            return d
+        """
+    (f,) = errors_of(
+        tmp_path, {"crdt_enc_tpu/core/m.py": src}, ["ASY001"]
+    )
+    assert "sync section" in f.message and f.line == 4
+
+
+def test_asy_sync_section_clean_and_unterminated(tmp_path):
+    assert not errors_of(tmp_path, {
+        "crdt_enc_tpu/core/ok.py": """\
+            async def seal(self):
+                # lint: sync-section-begin
+                d = self._data
+                cut = sorted(d)
+                # lint: sync-section-end
+                await self.storage.put(cut)
+            """,
+    }, ["ASY001"])
+    (f,) = errors_of(tmp_path, {
+        "crdt_enc_tpu/core/bad.py": """\
+            async def seal(self):
+                # lint: sync-section-begin
+                d = self._data
+                return d
+            """,
+    }, ["ASY001"])
+    assert "without a matching" in f.message
+
+
+# ------------------------------------------------------------- DET001
+
+
+def test_det_wall_clock_on_sim_surface_caught(tmp_path):
+    (f,) = errors_of(tmp_path, {
+        "crdt_enc_tpu/sim/m.py": """\
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+    }, ["DET001"])
+    assert "wall_clock" in f.message and "time.time" in f.message
+
+
+def test_det_daemon_module_is_a_surface(tmp_path):
+    (f,) = errors_of(tmp_path, {
+        "crdt_enc_tpu/serve/daemon.py": """\
+            import random
+
+            def roll():
+                return random.random()
+            """,
+    }, ["DET001"])
+    assert "rng" in f.message
+
+
+def test_det_seeded_seams_pass(tmp_path):
+    """uuid4 rides the ContextVar dispatch seam; a clock= parameter is a
+    dynamic call (honestly unresolved); seeded Random(seed) is not an
+    rng effect."""
+    assert not errors_of(tmp_path, {
+        "crdt_enc_tpu/sim/m.py": """\
+            import random
+            import uuid
+
+            def fresh_id():
+                return uuid.uuid4()
+
+            def step(clock):
+                return clock()
+
+            def rng_for(seed):
+                return random.Random(seed)
+            """,
+    }, ["DET001"])
+
+
+# ------------------------------------------------------------- MUT001
+
+
+def test_mut_unbumped_and_one_branch_caught(tmp_path):
+    errors = errors_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            from dataclasses import dataclass, field
+
+            @dataclass
+            class State:
+                entries: dict = field(default_factory=dict)
+                clock: dict = field(default_factory=dict)
+                _mut: int = field(default=0, compare=False, repr=False)
+
+                def never(self, k, v):
+                    self.entries[k] = v
+
+                def one_branch(self, k, v):
+                    if k in self.entries:
+                        self._mut += 1
+                    self.entries[k] = v
+            """,
+    }, ["MUT001"])
+    by_ctx = {f.context: f for f in errors}
+    assert set(by_ctx) == {"State.never", "State.one_branch"}
+    assert "never bumps" in by_ctx["State.never"].message
+    assert "one branch" in by_ctx["State.one_branch"].message
+
+
+def test_mut_dominating_bump_and_alias_write_semantics(tmp_path):
+    errors = errors_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            from dataclasses import dataclass, field
+
+            @dataclass
+            class State:
+                entries: dict = field(default_factory=dict)
+                _mut: int = field(default=0, compare=False, repr=False)
+
+                def good(self, k, v):
+                    self._mut += 1
+                    if v:
+                        self.entries[k] = v
+
+                def via_alias(self, k):
+                    e = self.entries
+                    e.pop(k, None)
+            """,
+    }, ["MUT001"])
+    assert [f.context for f in errors] == ["State.via_alias"]
+    assert "alias" in errors[0].message
+
+
+def test_mut_private_helper_obligation_moves_to_callers(tmp_path):
+    assert not errors_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            from dataclasses import dataclass, field
+
+            @dataclass
+            class State:
+                entries: dict = field(default_factory=dict)
+                _mut: int = field(default=0, compare=False, repr=False)
+
+                def apply(self, k, v):
+                    self._mut += 1
+                    self._store(k, v)
+
+                def _store(self, k, v):
+                    self.entries[k] = v
+            """,
+    }, ["MUT001"])
+
+
+def test_mut_module_writeback_needs_bump_unless_fresh(tmp_path):
+    errors = errors_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            from dataclasses import dataclass, field
+
+            @dataclass
+            class State:
+                entries: dict = field(default_factory=dict)
+                clock: dict = field(default_factory=dict)
+                _mut: int = field(default=0, compare=False, repr=False)
+
+            def writeback(state, clock):
+                state.clock = clock
+
+            def writeback_bumped(state, clock):
+                state._mut += 1
+                state.clock = clock
+
+            def fresh_build(clock):
+                s = State()
+                s.clock = clock
+                return s
+            """,
+    }, ["MUT001"])
+    (f,) = errors
+    assert f.context == "writeback" and "state._mut" in f.message
+
+
+# ------------------------------------------------------------- LCK001
+
+
+def test_lck_unlocked_access_of_guarded_field_caught(tmp_path):
+    (f,) = errors_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def peek(self):
+                    return self._items[-1]
+            """,
+    }, ["LCK001"])
+    assert f.context == "Box.peek" and "_items" in f.message
+
+
+def test_lck_consistent_locking_passes(tmp_path):
+    assert not errors_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def peek(self):
+                    with self._lock:
+                        return self._items[-1]
+            """,
+    }, ["LCK001"])
+
+
+def test_lck_await_under_threading_lock_caught(tmp_path):
+    errors = errors_of(tmp_path, {
+        "crdt_enc_tpu/m.py": """\
+            import asyncio
+            import threading
+
+            _LOCK = threading.Lock()
+
+            async def bad():
+                with _LOCK:
+                    await asyncio.sleep(0)
+
+            async def fine(lock: asyncio.Lock):
+                async with lock:
+                    await asyncio.sleep(0)
+            """,
+    }, ["LCK001"])
+    (f,) = errors
+    assert f.context == "bad" and "parks the event loop" in f.message
+
+
+# ---------------------------------------------------------------- CLI
+
+
+_REGISTRY_DOC = textwrap.dedent(
+    """\
+    # registry fixture
+
+    ## Span registry
+
+    | name | where |
+    |---|---|
+    | `phase.x` | fixture |
+    | `stream.h2d` | fixture |
+
+    ## Counter & gauge registry
+
+    | name | where |
+    |---|---|
+    | `h2d_bytes` | fixture |
+    | `events_dropped` | obs-internal |
+    """
+)
+
+
+def _mini_checkout(tmp_path, src):
+    (tmp_path / "crdt_enc_tpu").mkdir()
+    (tmp_path / "crdt_enc_tpu" / "mod.py").write_text(textwrap.dedent(src))
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(_REGISTRY_DOC)
+
+
+def test_cli_effects_dump_text(tmp_path, capsys):
+    _mini_checkout(tmp_path, """\
+        import time
+
+        def leaf():
+            time.sleep(1)
+
+        async def top():
+            leaf()
+        """)
+    assert cli_main(["--effects", "top", "--root", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "async def top" in out
+    assert "blocks: time.sleep" in out
+    assert "via" in out
+
+
+def test_cli_effects_json_schema(tmp_path, capsys):
+    _mini_checkout(tmp_path, """\
+        import time
+
+        def leaf(fns):
+            fns[0]()
+            return time.time()
+        """)
+    rc = cli_main(["--effects", "leaf", "--json", "--root", str(tmp_path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["version"] == 2
+    (fn,) = out["functions"]
+    assert set(fn) == {
+        "key", "qualname", "async", "effects", "unresolved", "sanctioned",
+    }
+    (eff,) = fn["effects"]
+    assert eff["kind"] == "wall_clock" and eff["chain"]
+    assert fn["unresolved"][0]["desc"].startswith("dynamic call")
+
+
+def test_cli_effects_unknown_qualname_is_usage_error(tmp_path, capsys):
+    _mini_checkout(tmp_path, "def f():\n    pass\n")
+    assert cli_main(["--effects", "nope.missing", "--root", str(tmp_path)]) == 2
+    assert "no function matching" in capsys.readouterr().err
+
+
+def test_cli_expect_json_version_pins_consumers(tmp_path, capsys):
+    _mini_checkout(tmp_path, "def f():\n    pass\n")
+    args = ["--json", "--rule", "THR001", "--root", str(tmp_path)]
+    assert cli_main(["--expect-json-version", "1", *args]) == 2
+    assert "schema version mismatch" in capsys.readouterr().err
+    assert cli_main(["--expect-json-version", "2", *args]) == 0
+
+
+def test_cli_partial_run_new_rules_no_spurious_findings(capsys):
+    """The path-subset contract extends to the new families: a
+    single-file run on a live module exits clean — no stale-baseline
+    errors, no findings that depend on modules outside the subset."""
+    rc = cli_main([
+        "--rule", "ASY001", "--rule", "DET001", "--rule", "MUT001",
+        "--rule", "LCK001", "--diff-baseline",
+        str(REPO / "crdt_enc_tpu" / "models" / "orset.py"),
+        "--root", str(REPO),
+    ])
+    assert rc == 0
+    assert "0 error(s)" in capsys.readouterr().out
+
+
+# ---------------------------------- genuine-finding runtime regressions
+
+
+def test_crdtmap_mut_epoch_bumps_on_apply_and_merge():
+    from crdt_enc_tpu.models import CrdtMap
+    from crdt_enc_tpu.models.orset import AddOp
+
+    actor = uuid.UUID(int=1).bytes
+    m = CrdtMap(child=b"orset")
+    before = m._mut
+    op = m.update_ctx(actor, "k", lambda child, dot: AddOp("v", dot))
+    assert m._mut == before  # deriving an op must NOT mutate
+    m.apply(op)
+    assert m._mut > before
+    other = CrdtMap(child=b"orset")
+    other.apply(other.update_ctx(uuid.UUID(int=2).bytes, "k2",
+                                 lambda child, dot: AddOp("w", dot)))
+    mid = m._mut
+    m.merge(other)
+    assert m._mut > mid
+
+
+def test_lwwmap_mut_epoch_bumps_on_apply_and_merge():
+    from crdt_enc_tpu.models.lwwmap import LWWMap
+
+    a, b = LWWMap(), LWWMap()
+    actor = uuid.UUID(int=1).bytes
+    before = a._mut
+    a.apply(a.put("k", 1, actor, "v"))
+    assert a._mut > before
+    b.apply(b.put("k", 2, actor, "w"))
+    mid = a._mut
+    a.merge(b)
+    assert a._mut > mid
+    # the epoch is bookkeeping, not state: equal maps stay equal
+    assert a == LWWMap.from_obj(a.to_obj())
+
+
+def test_crdtmap_fold_writeback_bumps_epoch():
+    from crdt_enc_tpu.models import CrdtMap, canonical_bytes
+    from crdt_enc_tpu.models.orset import AddOp
+    from crdt_enc_tpu.parallel.accel import TpuAccelerator
+    from crdt_enc_tpu.utils import codec
+
+    actor = uuid.UUID(int=1).bytes
+    proto = CrdtMap(child=b"orset")
+    oracle = CrdtMap(child=b"orset")
+    ops = []
+    for i in range(3):
+        op = oracle.update_ctx(actor, f"k{i}",
+                               lambda child, dot: AddOp("v", dot))
+        oracle.apply(op)
+        ops.append(op)
+    payloads = [codec.pack([proto.op_to_obj(op) for op in ops])]
+    folded = CrdtMap(child=b"orset")
+    before = folded._mut
+    ok = TpuAccelerator(min_device_batch=1).fold_payloads(
+        folded, payloads, actors_hint=[actor]
+    )
+    assert ok
+    assert folded._mut > before, "fold writeback must invalidate caches"
+    assert canonical_bytes(folded) == canonical_bytes(oracle)
+
+
+def test_orset_fresh_fold_native_self_bumps():
+    from crdt_enc_tpu import native
+    from crdt_enc_tpu.models import ORSet
+    from crdt_enc_tpu.ops.columnar import (
+        KIND_ADD,
+        Vocab,
+        _orset_fresh_fold_native,
+    )
+
+    try:
+        native.load_state()
+    except Exception:
+        pytest.skip("native state library unavailable")
+    members = Vocab(["m0", "m1"])
+    replicas = Vocab([uuid.UUID(int=1).bytes])
+    state = ORSet()
+    folded = _orset_fresh_fold_native(
+        state,
+        np.array([KIND_ADD, KIND_ADD], np.int8),
+        np.array([0, 1], np.int64),
+        np.array([0, 0], np.int64),
+        np.array([1, 2], np.int64),
+        members, replicas,
+        np.zeros(1, np.int64),
+    )
+    assert folded is not None
+    assert folded._mut > 0, "native writeback must self-protect the epoch"
+
+
+def test_core_open_warms_native_off_loop(monkeypatch):
+    from crdt_enc_tpu import native
+    from crdt_enc_tpu.backends import (
+        IdentityCryptor,
+        MemoryRemote,
+        MemoryStorage,
+        PlainKeyCryptor,
+    )
+    from crdt_enc_tpu.core import Core, OpenOptions, gcounter_adapter
+    from crdt_enc_tpu.utils.versions import DEFAULT_DATA_VERSION_1
+
+    calls = []
+    monkeypatch.setattr(native, "warm", lambda: calls.append(True))
+
+    async def go():
+        await Core.open(OpenOptions(
+            storage=MemoryStorage(MemoryRemote()),
+            cryptor=IdentityCryptor(),
+            key_cryptor=PlainKeyCryptor(),
+            adapter=gcounter_adapter(),
+            supported_data_versions=(DEFAULT_DATA_VERSION_1,),
+            current_data_version=DEFAULT_DATA_VERSION_1,
+            create=True,
+        ))
+
+    asyncio.run(go())
+    assert calls, "Core.open must warm the native build before first pack"
+
+
+def test_native_warm_swallows_build_failure(monkeypatch):
+    from crdt_enc_tpu import native
+
+    def boom():
+        raise RuntimeError("no compiler on this box")
+
+    monkeypatch.setattr(native, "load", boom)
+    monkeypatch.setattr(native, "load_state", boom)
+    native.warm()  # must not raise: pack() falls back to Python paths

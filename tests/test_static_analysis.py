@@ -1080,11 +1080,13 @@ def test_cli_json_schema_golden(tmp_path, capsys):
         "version", "root", "elapsed_s", "rules", "findings",
         "stale_baseline", "summary",
     }
-    assert out["version"] == 1 and out["rules"] == ["THR001"]
+    assert out["version"] == 2 and out["rules"] == ["THR001"]
     (finding,) = out["findings"]
+    # v2: findings carry `chain` (provenance call path; None for
+    # single-site rules like THR001)
     assert set(finding) == {
         "rule", "severity", "path", "line", "message", "context",
-        "suppressed",
+        "suppressed", "chain",
     }
     assert finding["rule"] == "THR001" and finding["suppressed"] is None
     assert set(out["summary"]) == {
@@ -1215,12 +1217,12 @@ def test_cli_non_checkout_root_is_usage_error(tmp_path, capsys):
     assert "--root" in capsys.readouterr().err
 
 
-def test_cli_list_rules_names_all_eight(capsys):
+def test_cli_list_rules_names_all_twelve(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule_id in (
         "FFI001", "JIT001", "JIT002", "EXC001", "THR001", "SPN001",
-        "OBS001", "SEC001",
+        "OBS001", "SEC001", "ASY001", "DET001", "MUT001", "LCK001",
     ):
         assert rule_id in out
 
